@@ -1,0 +1,52 @@
+"""heat_tpu: a TPU-native distributed array and data-analytics framework.
+
+A brand-new implementation of the capabilities of Heat (the Helmholtz
+Analytics Toolkit): NumPy-style global arrays partitioned along a ``split``
+axis, ~200 distributed operations, distributed linear algebra, a scikit-learn
+style ML layer, and data-parallel NN training — designed TPU-first on
+JAX/XLA/GSPMD/Pallas instead of PyTorch/MPI.
+
+The user-facing namespace is flat, like the reference's
+(heat/__init__.py star-imports core and registers subpackages):
+``ht.add``, ``ht.matmul``, ``ht.cluster.KMeans``, ...
+"""
+
+from .core import *
+from .core import (
+    arithmetics,
+    complex_math,
+    constants,
+    devices,
+    exponential,
+    factories,
+    indexing,
+    io,
+    linalg,
+    logical,
+    manipulations,
+    memory,
+    printing,
+    random,
+    relational,
+    rounding,
+    sanitation,
+    signal,
+    statistics,
+    stride_tricks,
+    tiling,
+    trigonometrics,
+    types,
+    version,
+)
+from .core.version import __version__
+from . import parallel
+from . import cluster
+from . import classification
+from . import graph
+from . import naive_bayes
+from . import regression
+from . import spatial
+from . import sparse
+from . import nn
+from . import optim
+from . import utils

@@ -1,0 +1,67 @@
+"""heat_tpu core: runtime, type system, and the NumPy-style op surface.
+
+Mirrors the reference's flat star-export layout (heat/core/__init__.py)."""
+
+# x64 policy: full 64-bit dtype parity on CPU (tests, NumPy comparisons);
+# native 32-bit defaults on TPU where float64 would be emulated.
+import os as _os
+
+import jax as _jax
+
+if _os.environ.get("HEAT_TPU_X64", "auto") == "auto":
+    if _jax.default_backend() == "cpu":
+        _jax.config.update("jax_enable_x64", True)
+elif _os.environ["HEAT_TPU_X64"] == "1":
+    _jax.config.update("jax_enable_x64", True)
+
+from . import version
+from .version import __version__
+from . import types
+from .types import *
+from . import devices
+from .devices import *
+from .devices import cpu, tpu
+from . import constants
+from .constants import *
+from .dndarray import *
+from . import factories
+from .factories import *
+from . import _operations
+from . import sanitation
+from .sanitation import *
+from . import stride_tricks
+from .stride_tricks import *
+from . import memory
+from .memory import *
+from . import printing
+from .printing import *
+from . import base
+from .base import *
+from . import arithmetics
+from .arithmetics import *
+from . import relational
+from .relational import *
+from . import logical
+from .logical import *
+from . import exponential
+from .exponential import *
+from . import trigonometrics
+from .trigonometrics import *
+from . import rounding
+from .rounding import *
+from . import complex_math
+from .complex_math import *
+from . import indexing
+from .indexing import *
+from . import statistics
+from .statistics import *
+from . import random
+from . import manipulations
+from .manipulations import *
+from . import io
+from .io import *
+from . import signal
+from .signal import *
+from . import tiling
+from . import linalg
+from .linalg import *

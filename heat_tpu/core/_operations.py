@@ -1,0 +1,219 @@
+"""Generic operation machinery (reference: heat/core/_operations.py).
+
+The reference's four workhorses map as follows:
+
+* ``__binary_op`` (:22-203) — type-promote, broadcast, align distributions,
+  apply. Here alignment is *declarative*: we pick the result split with the
+  reference's dominance rule and let XLA re-shard the other operand when the
+  computation runs (the hand-written lshape-map surgery at :149-174 has no
+  analog).
+* ``__reduce_op`` (:381-507) — local partial reduce + MPI Allreduce becomes a
+  single jnp reduction; XLA emits the cross-device all-reduce when the split
+  axis is reduced. Custom MPI ops (argmax twin-payload :476-482) are ordinary
+  jnp reductions.
+* ``__cum_op`` (:206-304) — local cumop + Exscan becomes jnp.cumsum/cumprod;
+  XLA partitions the scan.
+* ``__local_op`` (:307-378) — elementwise with float-cast policy; identical
+  role here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import sanitation, types
+from .dndarray import DNDarray, _ensure_split
+from .stride_tricks import broadcast_shape, sanitize_axes_for_reduction, sanitize_axis
+
+__all__ = ["_binary_op", "_local_op", "_reduce_op", "_cum_op"]
+
+
+def _as_operand(x, comm=None, device=None):
+    """Lift scalars / array-likes to (jax_value, split, is_scalar)."""
+    if isinstance(x, DNDarray):
+        return x, x.split
+    return x, None
+
+
+def _result_split(s1: Optional[int], s2: Optional[int], nd_out: int, nd1: int, nd2: int):
+    """Dominance rule for the output split (reference: _operations.py:90-148):
+    a distributed operand wins over a replicated one; when both are split the
+    first operand's split wins (the reference redistributes the second). Splits
+    are mapped through broadcasting's right-alignment."""
+
+    def mapped(split, nd_in):
+        if split is None:
+            return None
+        return split + (nd_out - nd_in)
+
+    m1, m2 = mapped(s1, nd1), mapped(s2, nd2)
+    if m1 is not None:
+        return m1
+    return m2
+
+
+def _binary_op(
+    operation: Callable,
+    t1,
+    t2,
+    out: Optional[DNDarray] = None,
+    where=None,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """Generic distributed binary operation (reference: _operations.py:22)."""
+    fn_kwargs = fn_kwargs or {}
+
+    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
+        raise TypeError(f"at least one operand must be a DNDarray, got {type(t1)}, {type(t2)}")
+
+    ref = t1 if isinstance(t1, DNDarray) else t2
+    comm, device = ref.comm, ref.device
+
+    if isinstance(t1, DNDarray) and isinstance(t2, DNDarray):
+        a, b = t1.larray, t2.larray
+        s1, s2, nd1, nd2 = t1.split, t2.split, t1.ndim, t2.ndim
+        out_shape = broadcast_shape(t1.shape, t2.shape)
+    elif isinstance(t1, DNDarray):
+        a = t1.larray
+        b = t2.larray if isinstance(t2, DNDarray) else t2
+        if isinstance(b, (list, tuple, np.ndarray)):
+            b = jnp.asarray(b)
+        s1, nd1 = t1.split, t1.ndim
+        s2, nd2 = None, (np.ndim(b) if not np.isscalar(b) else 0)
+        out_shape = broadcast_shape(t1.shape, np.shape(b))
+    else:
+        b = t2.larray
+        a = t1
+        if isinstance(a, (list, tuple, np.ndarray)):
+            a = jnp.asarray(a)
+        s2, nd2 = t2.split, t2.ndim
+        s1, nd1 = None, (np.ndim(a) if not np.isscalar(a) else 0)
+        out_shape = broadcast_shape(np.shape(a), t2.shape)
+
+    result = operation(a, b, **fn_kwargs)
+    split = _result_split(s1, s2, len(out_shape), nd1, nd2)
+    # a broadcast dimension of size 1 at the split cannot stay split
+    if split is not None and out_shape and out_shape[split] <= 1:
+        split = None
+
+    if where is not None:
+        wh = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
+        base = out.larray if out is not None else jnp.zeros(out_shape, result.dtype)
+        result = jnp.where(wh, result, base)
+
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        split, device, comm,
+    )
+    wrapped = _ensure_split(wrapped, split)
+    if out is not None:
+        sanitation.sanitize_out(out, tuple(result.shape), split, device)
+        out.larray = wrapped.parray.astype(out.dtype.jax_type())
+        return out
+    return wrapped
+
+
+def _local_op(
+    operation: Callable,
+    x: DNDarray,
+    out: Optional[DNDarray] = None,
+    no_cast: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """Elementwise operation with float-cast policy (reference:
+    _operations.py:307): integer inputs are promoted to the default float type
+    for transcendental ops unless ``no_cast``."""
+    sanitation.sanitize_in(x)
+    arr = x.larray
+    if not no_cast and not jnp.issubdtype(arr.dtype, jnp.inexact):
+        arr = arr.astype(jnp.float32)
+    result = operation(arr, **kwargs)
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        x.split if result.ndim == x.ndim else None, x.device, x.comm,
+    )
+    wrapped = _ensure_split(wrapped, wrapped.split)
+    if out is not None:
+        sanitation.sanitize_out(out, tuple(result.shape), wrapped.split, x.device)
+        out.larray = wrapped.parray.astype(out.dtype.jax_type())
+        return out
+    return wrapped
+
+
+def _reduce_op(
+    operation: Callable,
+    x: DNDarray,
+    axis=None,
+    out: Optional[DNDarray] = None,
+    keepdims: bool = False,
+    dtype=None,
+    initial=None,
+    **kwargs,
+) -> DNDarray:
+    """Generic reduction (reference: _operations.py:381). The reference's
+    local-reduce + Allreduce + neutral-fill dance is a single jnp call; XLA
+    inserts the cross-device reduce when the split axis participates."""
+    sanitation.sanitize_in(x)
+    axes, was_none = sanitize_axes_for_reduction(x.shape, axis)
+    arr = x.larray
+    if dtype is not None:
+        arr = arr.astype(types.canonical_heat_type(dtype).jax_type())
+    call_axis = None if was_none else (axes if len(axes) > 1 else axes[0])
+    result = operation(arr, axis=call_axis, keepdims=keepdims, **kwargs)
+
+    # result split (reference: reduced-away split → replicated)
+    split = x.split
+    if split is not None:
+        if split in axes:
+            split = None
+        elif keepdims:
+            pass  # dims retained, split index unchanged
+        else:
+            split -= sum(1 for ax in axes if ax < split)
+    if np.ndim(result) == 0:
+        split = None
+
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        split, x.device, x.comm,
+    )
+    wrapped = _ensure_split(wrapped, split)
+    if out is not None:
+        sanitation.sanitize_out(out, tuple(result.shape), split, x.device)
+        out.larray = wrapped.parray.astype(out.dtype.jax_type())
+        return out
+    return wrapped
+
+
+def _cum_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: int,
+    out: Optional[DNDarray] = None,
+    dtype=None,
+) -> DNDarray:
+    """Generic cumulative operation (reference: _operations.py:206). The
+    local-cumop + Exscan + combine pipeline is one partitioned jnp scan."""
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if axis is None:
+        raise NotImplementedError("cumulative ops require an axis")
+    arr = x.larray
+    if dtype is not None:
+        arr = arr.astype(types.canonical_heat_type(dtype).jax_type())
+    result = operation(arr, axis=axis)
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        x.split, x.device, x.comm,
+    )
+    wrapped = _ensure_split(wrapped, x.split)
+    if out is not None:
+        sanitation.sanitize_out(out, tuple(result.shape), x.split, x.device)
+        out.larray = wrapped.parray.astype(out.dtype.jax_type())
+        return out
+    return wrapped

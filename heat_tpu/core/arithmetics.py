@@ -1,0 +1,266 @@
+"""Arithmetic operations (reference: heat/core/arithmetics.py, 1031 LoC).
+
+Every function is a thin wrapper over the generic machinery in
+``_operations`` — exactly the reference's structure — with jnp supplying the
+elementwise kernel that the reference takes from torch. Operator overloads are
+bound onto DNDarray at import time, as the reference does.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "copysign",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "hypot",
+    "invert",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "nanprod",
+    "nansum",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise addition (reference: arithmetics.py add)."""
+    return _operations._binary_op(jnp.add, t1, t2, out=out, where=where)
+
+
+def _check_int_or_bool(*operands):
+    for t in operands:
+        if isinstance(t, DNDarray):
+            if types.heat_type_is_inexact(t.dtype):
+                raise TypeError(f"expected integer or boolean operand, got {t.dtype.__name__}")
+        elif isinstance(t, float):
+            raise TypeError("expected integer or boolean operand, got float")
+
+
+def bitwise_and(t1, t2, out=None, where=None) -> DNDarray:
+    """Elementwise AND of integer/boolean arrays."""
+    _check_int_or_bool(t1, t2)
+    return _operations._binary_op(jnp.bitwise_and, t1, t2, out=out, where=where)
+
+
+def bitwise_or(t1, t2, out=None, where=None) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return _operations._binary_op(jnp.bitwise_or, t1, t2, out=out, where=where)
+
+
+def bitwise_xor(t1, t2, out=None, where=None) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return _operations._binary_op(jnp.bitwise_xor, t1, t2, out=out, where=where)
+
+
+def bitwise_not(t, out=None) -> DNDarray:
+    _check_int_or_bool(t)
+    return _operations._local_op(jnp.bitwise_not, t, out=out, no_cast=True)
+
+
+invert = bitwise_not
+
+
+def copysign(t1, t2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.copysign, t1, t2, out=out, where=where)
+
+
+def cumprod(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product along ``axis`` (reference: partitioned scan)."""
+    return _operations._cum_op(jnp.cumprod, a, axis, out=out, dtype=dtype)
+
+
+cumproduct = cumprod
+
+
+def cumsum(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum along ``axis``."""
+    return _operations._cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype)
+
+
+def diff(a, n: int = 1, axis: int = -1) -> DNDarray:
+    """n-th discrete difference along ``axis`` (reference: arithmetics.py diff;
+    there a halo exchange, here one sharded slice-subtract)."""
+    from .stride_tricks import sanitize_axis
+
+    axis = sanitize_axis(a.shape, axis)
+    result = jnp.diff(a.larray, n=n, axis=axis)
+    split = a.split
+    out = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        split, a.device, a.comm,
+    )
+    from .dndarray import _ensure_split
+
+    return _ensure_split(out, split)
+
+
+def div(t1, t2, out=None, where=None) -> DNDarray:
+    """True division."""
+    return _operations._binary_op(jnp.true_divide, t1, t2, out=out, where=where)
+
+
+divide = div
+
+
+def floordiv(t1, t2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.floor_divide, t1, t2, out=out, where=where)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2, out=None, where=None) -> DNDarray:
+    """C-style (truncated) remainder."""
+    return _operations._binary_op(jnp.fmod, t1, t2, out=out, where=where)
+
+
+def hypot(t1, t2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.hypot, t1, t2, out=out, where=where)
+
+
+def left_shift(t1, t2, out=None, where=None) -> DNDarray:
+    _check_int_or_bool(t1)
+    return _operations._binary_op(jnp.left_shift, t1, t2, out=out, where=where)
+
+
+def mod(t1, t2, out=None, where=None) -> DNDarray:
+    """Python-style (floored) modulo."""
+    return _operations._binary_op(jnp.mod, t1, t2, out=out, where=where)
+
+
+remainder = mod
+
+
+def mul(t1, t2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.multiply, t1, t2, out=out, where=where)
+
+
+multiply = mul
+
+
+def nanprod(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _operations._reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=keepdims)
+
+
+def nansum(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    return _operations._reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=keepdims)
+
+
+def neg(t, out=None) -> DNDarray:
+    return _operations._local_op(jnp.negative, t, out=out, no_cast=True)
+
+
+negative = neg
+
+
+def pos(t, out=None) -> DNDarray:
+    return _operations._local_op(jnp.positive, t, out=out, no_cast=True)
+
+
+positive = pos
+
+
+def pow(t1, t2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.power, t1, t2, out=out, where=where)
+
+
+power = pow
+
+
+def prod(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Product reduction (reference: __reduce_op with MPI.PROD)."""
+    return _operations._reduce_op(jnp.prod, a, axis=axis, out=out, keepdims=keepdims)
+
+
+def right_shift(t1, t2, out=None, where=None) -> DNDarray:
+    _check_int_or_bool(t1)
+    return _operations._binary_op(jnp.right_shift, t1, t2, out=out, where=where)
+
+
+def sub(t1, t2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.subtract, t1, t2, out=out, where=where)
+
+
+subtract = sub
+
+
+def sum(a, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Sum reduction (reference: __reduce_op with MPI.SUM → here one jnp.sum,
+    all-reduce inserted by XLA when the split axis is reduced)."""
+    return _operations._reduce_op(jnp.sum, a, axis=axis, out=out, keepdims=keepdims)
+
+
+# --------------------------------------------------------- operator binding
+def _bind_operators():
+    DNDarray.__add__ = lambda self, other: add(self, other)
+    DNDarray.__radd__ = lambda self, other: add(other, self)
+    DNDarray.__sub__ = lambda self, other: sub(self, other)
+    DNDarray.__rsub__ = lambda self, other: sub(other, self)
+    DNDarray.__mul__ = lambda self, other: mul(self, other)
+    DNDarray.__rmul__ = lambda self, other: mul(other, self)
+    DNDarray.__truediv__ = lambda self, other: div(self, other)
+    DNDarray.__rtruediv__ = lambda self, other: div(other, self)
+    DNDarray.__floordiv__ = lambda self, other: floordiv(self, other)
+    DNDarray.__rfloordiv__ = lambda self, other: floordiv(other, self)
+    DNDarray.__mod__ = lambda self, other: mod(self, other)
+    DNDarray.__rmod__ = lambda self, other: mod(other, self)
+    DNDarray.__pow__ = lambda self, other: pow(self, other)
+    DNDarray.__rpow__ = lambda self, other: pow(other, self)
+    DNDarray.__neg__ = lambda self: neg(self)
+    DNDarray.__pos__ = lambda self: pos(self)
+    DNDarray.__invert__ = lambda self: invert(self)
+    DNDarray.__lshift__ = lambda self, other: left_shift(self, other)
+    DNDarray.__rshift__ = lambda self, other: right_shift(self, other)
+    DNDarray.__and__ = lambda self, other: bitwise_and(self, other)
+    DNDarray.__rand__ = lambda self, other: bitwise_and(other, self)
+    DNDarray.__or__ = lambda self, other: bitwise_or(self, other)
+    DNDarray.__ror__ = lambda self, other: bitwise_or(other, self)
+    DNDarray.__xor__ = lambda self, other: bitwise_xor(self, other)
+    DNDarray.__rxor__ = lambda self, other: bitwise_xor(other, self)
+    DNDarray.__abs__ = lambda self: __import__(
+        "heat_tpu.core.rounding", fromlist=["abs"]
+    ).abs(self)
+    # reduction methods
+    DNDarray.sum = lambda self, axis=None, out=None, keepdims=False: sum(
+        self, axis=axis, out=out, keepdims=keepdims
+    )
+    DNDarray.prod = lambda self, axis=None, out=None, keepdims=False: prod(
+        self, axis=axis, out=out, keepdims=keepdims
+    )
+    DNDarray.cumsum = lambda self, axis, dtype=None, out=None: cumsum(self, axis, dtype, out)
+    DNDarray.cumprod = lambda self, axis, dtype=None, out=None: cumprod(self, axis, dtype, out)
+
+
+_bind_operators()

@@ -1,0 +1,30 @@
+"""Complex number operations (reference: heat/core/complex_math.py, ~210 LoC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x, deg: bool = False, out=None) -> DNDarray:
+    """Phase angle (reference: complex_math.py angle)."""
+    return _operations._local_op(lambda t: jnp.angle(t, deg=deg), x, out=out, no_cast=True)
+
+
+def conjugate(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.conjugate, x, out=out, no_cast=True)
+
+
+conj = conjugate
+
+
+def imag(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.imag, x, out=out, no_cast=True)
+
+
+def real(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.real, x, out=out, no_cast=True)

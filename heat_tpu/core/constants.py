@@ -1,0 +1,17 @@
+"""Mathematical constants (reference: heat/core/constants.py)."""
+
+import math
+
+INF = float("inf")
+NAN = float("nan")
+NINF = -float("inf")
+PI = math.pi
+E = math.e
+
+# lowercase aliases, as exported by the reference
+inf = INF
+nan = NAN
+pi = PI
+e = E
+
+__all__ = ["e", "inf", "nan", "pi", "E", "INF", "NAN", "NINF", "PI"]

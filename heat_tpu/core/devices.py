@@ -1,0 +1,115 @@
+"""Device handling (reference: heat/core/devices.py:17-183).
+
+The reference exposes ``cpu`` always and ``gpu`` iff CUDA is present, with a
+process-global default switched by ``use_device``.  Here the native accelerator
+is the TPU: ``tpu`` exists iff a TPU backend is initialized; ``cpu`` always
+exists.  A ``Device`` names a JAX platform — actual placement of a DNDarray is
+governed by its communication context's mesh (built over devices of that
+platform).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+__all__ = ["Device", "cpu", "tpu", "get_device", "sanitize_device", "use_device"]
+
+
+class Device:
+    """Represents a device backend on which heat_tpu arrays live
+    (reference: Device, heat/core/devices.py:17).
+
+    Parameters
+    ----------
+    device_type : str
+        JAX platform name: ``"cpu"`` or ``"tpu"``.
+    device_id : int
+        Ordinal (kept for API parity; mesh placement supersedes it).
+    """
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.__device_type = device_type
+        self.__device_id = device_id
+
+    @property
+    def device_type(self) -> str:
+        return self.__device_type
+
+    @property
+    def device_id(self) -> int:
+        return self.__device_id
+
+    @property
+    def jax_devices(self):
+        """All JAX devices of this platform."""
+        return jax.devices(self.__device_type)
+
+    # reference-compat: heat's Device.torch_device returns the native handle
+    @property
+    def jax_device(self):
+        return jax.devices(self.__device_type)[self.__device_id % len(self.jax_devices)]
+
+    def __repr__(self) -> str:
+        return f"device({str(self)!r})"
+
+    def __str__(self) -> str:
+        return f"{self.__device_type}:{self.__device_id}"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Device):
+            return self.device_type == other.device_type and self.device_id == other.device_id
+        if isinstance(other, str):
+            try:
+                return self == sanitize_device(other)
+            except (ValueError, TypeError):
+                return False
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(str(self))
+
+
+cpu = Device("cpu")
+"""The host CPU device (reference: devices.py:95)."""
+
+# the TPU singleton exists iff a tpu backend is actually available
+try:
+    _tpu_devices = jax.devices("tpu")
+    tpu: Optional[Device] = Device("tpu")
+except RuntimeError:
+    _tpu_devices = []
+    tpu = None
+
+__default_device: Device = tpu if tpu is not None else cpu
+
+
+def get_device() -> Device:
+    """The currently-default device (reference: devices.py:137)."""
+    return __default_device
+
+
+def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
+    """Normalize a device argument (reference: devices.py:149)."""
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    if isinstance(device, str):
+        name, _, ordinal = device.partition(":")
+        name = name.strip().lower()
+        if name == "cpu":
+            return cpu if not ordinal else Device("cpu", int(ordinal))
+        if name in ("tpu", "gpu"):  # "gpu" tolerated as accelerator alias
+            if tpu is None:
+                raise ValueError("no TPU backend available")
+            return tpu if not ordinal else Device("tpu", int(ordinal))
+        raise ValueError(f"unknown device {device!r}")
+    raise TypeError(f"device must be None, str or Device, got {type(device)}")
+
+
+def use_device(device: Optional[Union[str, Device]] = None) -> None:
+    """Set the process-global default device (reference: devices.py:173)."""
+    global __default_device
+    __default_device = sanitize_device(device)

@@ -1,0 +1,319 @@
+"""Array factories (reference: heat/core/factories.py).
+
+``array()`` (reference :151) is the central constructor; ``arange`` (:41),
+``empty/full/ones/zeros`` + ``_like`` variants via shared helpers (:672, :726),
+``eye`` (:593), ``linspace`` (:1053), ``logspace`` (:1139), ``meshgrid``
+(:1202), ``asarray`` (:441), ``from_partitioned`` (:796).
+
+TPU-native behavior: a factory builds the *global* array and places it with a
+``NamedSharding`` in one step; with a ``split``, XLA materializes each shard on
+its own device (no scatter of host data when the input is a shape, and a
+single host→device transfer per shard when the input is host data).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple, Type, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import devices, types
+from .dndarray import DNDarray, _physical_dim, _to_physical
+from ..parallel.mesh import MeshComm, sanitize_comm
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "arange",
+    "array",
+    "asarray",
+    "empty",
+    "empty_like",
+    "eye",
+    "full",
+    "full_like",
+    "from_partitioned",
+    "from_partition_dict",
+    "linspace",
+    "logspace",
+    "meshgrid",
+    "ones",
+    "ones_like",
+    "zeros",
+    "zeros_like",
+]
+
+
+def _finalize(
+    garray: jax.Array,
+    split: Optional[int],
+    device: Optional[Union[str, devices.Device]],
+    comm: Optional[MeshComm],
+    dtype: Optional[Type[types.datatype]] = None,
+) -> DNDarray:
+    """Place a global jax array onto the mesh with the canonical sharding for
+    ``split`` and wrap it."""
+    comm = sanitize_comm(comm)
+    device = devices.sanitize_device(device)
+    split = sanitize_axis(garray.shape, split)
+    gshape = tuple(garray.shape)
+    garray = _to_physical(garray, gshape, split, comm)
+    heat_type = types.canonical_heat_type(garray.dtype) if dtype is None else dtype
+    return DNDarray(garray, gshape, heat_type, split, device, comm)
+
+
+def array(
+    obj,
+    dtype: Optional[Type[types.datatype]] = None,
+    copy: bool = True,
+    ndmin: int = 0,
+    order: str = "C",
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device=None,
+    comm: Optional[MeshComm] = None,
+) -> DNDarray:
+    """Create a DNDarray from array-like data (reference: factories.py:151).
+
+    ``split`` shards the (global) input along that axis; ``is_split`` declares
+    the input to be this *process's* local chunk of a pre-distributed global
+    array (multi-host; with a single controller process the local chunk is the
+    whole array).
+    """
+    if split is not None and is_split is not None:
+        raise ValueError("split and is_split are mutually exclusive")
+    comm = sanitize_comm(comm)
+
+    if isinstance(obj, DNDarray):
+        base = obj.larray
+        if dtype is not None:
+            base = base.astype(types.canonical_heat_type(dtype).jax_type())
+        if split is None and is_split is None:
+            split = obj.split
+        new = _finalize(base, split if is_split is None else is_split, device or obj.device, comm, dtype=None)
+        return new
+
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+
+    if is_split is not None:
+        # multi-host: assemble the global array from per-process local chunks
+        local = np.asarray(obj, order=order)
+        if dtype is not None:
+            local = local.astype(np.dtype(types._np_equivalent(dtype)))
+        if local.ndim < ndmin:
+            local = local.reshape((1,) * (ndmin - local.ndim) + local.shape)
+        is_split = sanitize_axis(local.shape, is_split)
+        if jax.process_count() > 1:
+            sharding = comm.sharding(is_split, local.ndim)
+            garray = jax.make_array_from_process_local_data(sharding, local)
+            return _finalize(garray, is_split, device, comm)
+        return _finalize(jnp.asarray(local), is_split, device, comm)
+
+    if isinstance(obj, (jax.Array,)):
+        garray = obj
+        if dtype is not None:
+            garray = garray.astype(dtype.jax_type())
+    else:
+        host = np.asarray(obj, order=order)
+        if dtype is not None:
+            host = host.astype(np.dtype(types._np_equivalent(dtype)))
+        garray = jnp.asarray(host)
+    if garray.ndim < ndmin:
+        garray = garray.reshape((1,) * (ndmin - garray.ndim) + garray.shape)
+    return _finalize(garray, split, device, comm)
+
+
+def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None, comm=None) -> DNDarray:
+    """No-copy-when-possible array construction (reference: factories.py:441)."""
+    if isinstance(obj, DNDarray) and dtype is None and is_split is None:
+        return obj
+    return array(obj, dtype=dtype, copy=False, order=order, is_split=is_split, device=device, comm=comm)
+
+
+def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Evenly spaced values in [start, stop) (reference: factories.py:41)."""
+    num_args = len(args)
+    if num_args == 1:
+        start, stop, step = 0, args[0], 1
+    elif num_args == 2:
+        start, stop, step = args[0], args[1], 1
+    elif num_args == 3:
+        start, stop, step = args
+    else:
+        raise TypeError(f"arange takes 1-3 positional arguments, got {num_args}")
+    jdtype = types.canonical_heat_type(dtype).jax_type() if dtype is not None else None
+    garray = jnp.arange(start, stop, step, dtype=jdtype)
+    return _finalize(garray, split, device, comm)
+
+
+def __factory(shape, dtype, split, fill, device, comm, order="C") -> DNDarray:
+    """Shared shape-based factory (reference: factories.py:672)."""
+    shape = sanitize_shape(shape)
+    dtype = types.canonical_heat_type(dtype)
+    comm = sanitize_comm(comm)
+    split = sanitize_axis(shape, split)
+    # build on-device directly at the physical (even-chunk) shape: jit with
+    # out_shardings materializes each shard on its own device, no host round-trip
+    pshape = list(shape)
+    if split is not None and shape:
+        pshape[split] = _physical_dim(shape[split], comm.size)
+    sharding = comm.sharding(split, len(shape))
+    fn = jax.jit(lambda: fill(tuple(pshape), dtype.jax_type()), out_shardings=sharding)
+    garray = fn()
+    return DNDarray(
+        garray, shape, types.canonical_heat_type(garray.dtype),
+        split, devices.sanitize_device(device), comm,
+    )
+
+
+def __factory_like(a, dtype, split, factory, device, comm, **kwargs) -> DNDarray:
+    """Shared like-based factory (reference: factories.py:726)."""
+    if isinstance(a, DNDarray):
+        shape = a.shape
+        dtype = dtype if dtype is not None else a.dtype
+        split = split if split is not None else a.split
+        device = device if device is not None else a.device
+        comm = comm if comm is not None else a.comm
+    else:
+        arr = np.asarray(a)
+        shape = arr.shape
+        dtype = dtype if dtype is not None else types.canonical_heat_type(arr.dtype)
+    return factory(shape, dtype=dtype, split=split, device=device, comm=comm, **kwargs)
+
+
+def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Uninitialized array (reference: factories.py:495). XLA has no
+    uninitialized allocation; zeros are as cheap under fusion."""
+    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm)
+
+
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, empty, device, comm)
+
+
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """2-D identity-like array (reference: factories.py:593)."""
+    if isinstance(shape, (int, np.integer)):
+        n, m = int(shape), int(shape)
+    else:
+        shape = sanitize_shape(shape)
+        if len(shape) == 1:
+            n = m = shape[0]
+        else:
+            n, m = shape[0], shape[1]
+    dtype_ = types.canonical_heat_type(dtype)
+    comm = sanitize_comm(comm)
+    split_ = sanitize_axis((n, m), split)
+    garray = _to_physical(jnp.eye(n, m, dtype=dtype_.jax_type()), (n, m), split_, comm)
+    return DNDarray(
+        garray, (n, m), types.canonical_heat_type(garray.dtype),
+        split_, devices.sanitize_device(device), comm,
+    )
+
+
+def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Constant-filled array (reference: factories.py:946)."""
+    if dtype is None:
+        dtype = types.float32  # reference default (factories.py:946)
+    value = fill_value.item() if hasattr(fill_value, "item") else fill_value
+    return __factory(shape, dtype, split, lambda s, d: jnp.full(s, value, d), device, comm)
+
+
+def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, lambda *ar, **kw: full(ar[0], fill_value, dtype=kw.get("dtype"), split=kw.get("split"), device=kw.get("device"), comm=kw.get("comm")), device, comm)
+
+
+def linspace(
+    start, stop, num=50, endpoint=True, retstep=False, dtype=None, split=None, device=None, comm=None
+):
+    """num evenly spaced samples over [start, stop] (reference: factories.py:1053)."""
+    num = int(num)
+    jdtype = types.canonical_heat_type(dtype).jax_type() if dtype is not None else None
+    garray = jnp.linspace(float(start), float(stop), num=num, endpoint=endpoint, dtype=jdtype)
+    ht = _finalize(garray, split, device, comm)
+    if retstep:
+        step = (float(stop) - float(start)) / max(num - (1 if endpoint else 0), 1)
+        return ht, step
+    return ht
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Log-spaced samples (reference: factories.py:1139)."""
+    jdtype = types.canonical_heat_type(dtype).jax_type() if dtype is not None else None
+    garray = jnp.logspace(float(start), float(stop), num=int(num), endpoint=endpoint, base=base, dtype=jdtype)
+    return _finalize(garray, split, device, comm)
+
+
+def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
+    """Coordinate matrices from coordinate vectors (reference: factories.py:1202).
+
+    The reference supports at most one split input; here any input split is
+    propagated to the corresponding output dimension."""
+    if not arrays:
+        return []
+    splits = [a.split if isinstance(a, DNDarray) else None for a in arrays]
+    jargs = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    comm = next((a.comm for a in arrays if isinstance(a, DNDarray)), None)
+    device = next((a.device for a in arrays if isinstance(a, DNDarray)), None)
+    outs = jnp.meshgrid(*jargs, indexing=indexing)
+    results = []
+    ndim = len(jargs)
+    for i, out in enumerate(outs):
+        # dim that input i varies along in the output
+        if indexing == "xy" and ndim >= 2:
+            dim_of_input = {0: 1, 1: 0}.get(i, i)
+        else:
+            dim_of_input = i
+        out_split = dim_of_input if splits[i] is not None else None
+        results.append(_finalize(out, out_split, device, comm))
+    return results
+
+
+def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Ones (reference: factories.py:1285)."""
+    return __factory(shape, dtype, split, lambda s, d: jnp.ones(s, d), device, comm)
+
+
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, ones, device, comm)
+
+
+def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Zeros (reference: factories.py:1382)."""
+    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm)
+
+
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, zeros, device, comm)
+
+
+def from_partitioned(x, comm=None) -> DNDarray:
+    """Construct from an object exposing ``__partitioned__`` (reference:
+    factories.py:796)."""
+    parts = x.__partitioned__
+    return from_partition_dict(parts, comm=comm)
+
+
+def from_partition_dict(parts: dict, comm=None) -> DNDarray:
+    """Construct from a GAI partition dict (reference: factories.py:841)."""
+    shape = tuple(parts["shape"])
+    tiling = tuple(parts["partition_tiling"])
+    split_dims = [i for i, t in enumerate(tiling) if t > 1]
+    split = split_dims[0] if split_dims else None
+    get = parts["get"]
+    chunks = []
+    keys = sorted(parts["partitions"].keys())
+    for key in keys:
+        p = parts["partitions"][key]
+        data = p["data"] if p.get("data") is not None else get(
+            tuple(slice(s, s + l) for s, l in zip(p["start"], p["shape"]))
+        )
+        chunks.append(np.asarray(data))
+    if split is None:
+        global_arr = chunks[0]
+    else:
+        global_arr = np.concatenate(chunks, axis=split)
+    return array(global_arr, split=split, comm=comm)

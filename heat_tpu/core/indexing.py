@@ -1,0 +1,44 @@
+"""Index-returning operations (reference: heat/core/indexing.py, ~150 LoC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import _operations, sanitation, types
+from .dndarray import DNDarray, _ensure_split
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x) -> DNDarray:
+    """Indices of nonzero elements as an (nnz, ndim) array (reference:
+    indexing.py nonzero — local nonzero + offset by displs there; a global
+    gather-free jnp.nonzero here, result replicated since nnz is data-
+    dependent)."""
+    sanitation.sanitize_in(x)
+    idx = jnp.stack(jnp.nonzero(x.larray), axis=1) if x.ndim > 1 else jnp.nonzero(x.larray)[0]
+    return DNDarray(
+        idx, tuple(idx.shape), types.canonical_heat_type(idx.dtype),
+        None, x.device, x.comm,
+    )
+
+
+def where(cond, x=None, y=None) -> DNDarray:
+    """3-arg select / 1-arg nonzero (reference: indexing.py where)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    sanitation.sanitize_in(cond)
+    xv = x.larray if isinstance(x, DNDarray) else x
+    yv = y.larray if isinstance(y, DNDarray) else y
+    result = jnp.where(cond.larray, xv, yv)
+    split = cond.split
+    if split is not None and result.ndim != cond.ndim:
+        split = None
+    out = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        split, cond.device, cond.comm,
+    )
+    return _ensure_split(out, split)

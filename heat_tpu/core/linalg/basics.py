@@ -1,0 +1,290 @@
+"""Linear algebra basics (reference: heat/core/linalg/basics.py, 2412 LoC).
+
+The reference's ``matmul`` (:424) is a ~700-line dispatch table over
+``(a.split, b.split)`` with hand-rolled block rings (Ibcast/Isend of tiles,
+``__mm_c_block_setter:1980``).  On TPU the entire table is **one einsum under
+GSPMD**: the operands carry shardings, XLA chooses the collective schedule
+(all-gather vs reduce-scatter rings over ICI) — this is the single biggest
+architectural win of the rebuild (SURVEY.md §2.2).
+
+Result-split convention matches the reference: ``a.split==0 → out split 0``,
+``b.split==1 → out split 1``, inner-dim splits all-reduce into the dominant
+operand's layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import factories, sanitation, types
+from ..dndarray import DNDarray, _ensure_split
+from ..stride_tricks import sanitize_axis
+
+__all__ = [
+    "cross",
+    "det",
+    "dot",
+    "inv",
+    "matmul",
+    "matrix_norm",
+    "norm",
+    "outer",
+    "projection",
+    "trace",
+    "transpose",
+    "tril",
+    "triu",
+    "vdot",
+    "vecdot",
+    "vector_norm",
+]
+
+
+def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+    """Distributed matrix product (reference: basics.py:424).
+
+    The output split follows the reference's case table: split-0 ``a`` keeps
+    the row partition, split-1 ``b`` keeps the column partition, inner splits
+    reduce away."""
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    if a.ndim >= 1 and b.ndim >= 1:
+        k_a = a.shape[-1]
+        k_b = b.shape[-2] if b.ndim >= 2 else b.shape[0]
+        if k_a != k_b:
+            raise ValueError(
+                f"matmul: inner dimensions do not match: {a.shape} @ {b.shape}"
+            )
+    promoted = types.promote_types(a.dtype, b.dtype)
+    av = a.larray.astype(promoted.jax_type())
+    bv = b.larray.astype(promoted.jax_type())
+    result = jnp.matmul(av, bv)
+
+    nd_out = result.ndim
+    if a.ndim >= 2 and a.split == a.ndim - 2:
+        # row split survives; with a 1-D b the row dim is the *last* out dim
+        split = nd_out - 2 if b.ndim >= 2 else nd_out - 1
+    elif b.ndim >= 2 and b.split == b.ndim - 1:  # col split survives
+        split = nd_out - 1
+    elif a.ndim >= 2 and a.split is not None and a.split < a.ndim - 2:
+        split = a.split  # batch dims
+    elif b.ndim >= 2 and b.split is not None and b.split < b.ndim - 2:
+        split = b.split
+    else:
+        split = None
+    if split is not None and (split < 0 or nd_out == 0):
+        split = None
+    out = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        split, a.device, a.comm,
+    )
+    return _ensure_split(out, split)
+
+
+def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
+    """Dot product (reference: basics.py:246): 1-D·1-D → scalar (an Allreduce
+    there, a partitioned reduction here); 2-D falls through to matmul."""
+    if a.ndim == 1 and b.ndim == 1:
+        result = jnp.dot(a.larray, b.larray)
+        ret = DNDarray(result, (), types.canonical_heat_type(result.dtype), None, a.device, a.comm)
+        if out is not None:
+            out.larray = ret.larray
+            return out
+        return ret
+    ret = matmul(a, b)
+    if out is not None:
+        out.larray = ret.larray
+        return out
+    return ret
+
+
+def outer(a: DNDarray, b: DNDarray, out=None, split=None) -> DNDarray:
+    """Outer product (reference: basics.py:1386 — a ring of shard passes
+    there; one sharded broadcast-multiply here)."""
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    av = a.larray.reshape(-1)
+    bv = b.larray.reshape(-1)
+    result = jnp.outer(av, bv)
+    if split is None:
+        split = 0 if (a.split is not None or b.split is not None) else None
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        split, a.device, a.comm,
+    )
+    wrapped = _ensure_split(wrapped, split)
+    if out is not None:
+        out.larray = wrapped.larray
+        return out
+    return wrapped
+
+
+def det(a: DNDarray) -> DNDarray:
+    """Determinant (reference: basics.py:160 — distributed row elimination
+    with per-pivot Bcast; XLA's LU on the global array here)."""
+    sanitation.sanitize_in(a)
+    _square_check(a)
+    arr = a.larray
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        arr = arr.astype(jnp.float32)
+    result = jnp.linalg.det(arr)
+    return DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, a.device, a.comm)
+
+
+def inv(a: DNDarray) -> DNDarray:
+    """Matrix inverse (reference: basics.py:312)."""
+    sanitation.sanitize_in(a)
+    _square_check(a)
+    arr = a.larray
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        arr = arr.astype(jnp.float32)
+    result = jnp.linalg.inv(arr)
+    out = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype),
+        a.split, a.device, a.comm,
+    )
+    return _ensure_split(out, a.split)
+
+
+def _square_check(a: DNDarray):
+    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+        raise RuntimeError(f"expected square matrix, got shape {a.shape}")
+
+
+def matrix_norm(x: DNDarray, axis=None, keepdims=False, ord=None) -> DNDarray:
+    """Matrix norm (reference: basics.py:1109)."""
+    sanitation.sanitize_in(x)
+    if axis is None:
+        if x.ndim != 2:
+            raise ValueError("matrix_norm requires 2-D input or an explicit 2-tuple axis")
+        axis = (0, 1)
+    result = jnp.linalg.norm(
+        x.larray.astype(jnp.float32) if not jnp.issubdtype(x.larray.dtype, jnp.inexact) else x.larray,
+        ord=ord, axis=tuple(axis), keepdims=keepdims,
+    )
+    # the reduced axes include the split either way → replicated result
+    out = DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, x.device, x.comm)
+    return _ensure_split(out, None)
+
+
+def norm(x: DNDarray, axis=None, keepdims=False, ord=None) -> DNDarray:
+    """Vector/matrix norm (reference: basics.py:1237)."""
+    sanitation.sanitize_in(x)
+    arr = x.larray
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        arr = arr.astype(jnp.float32)
+    if axis is None and ord is None:
+        result = jnp.linalg.norm(arr.reshape(-1))
+    else:
+        result = jnp.linalg.norm(arr, ord=ord, axis=axis, keepdims=keepdims)
+    split = None
+    if axis is not None and np.ndim(result) > 0 and x.split is not None:
+        ax = axis if isinstance(axis, tuple) else (axis,)
+        ax = tuple(a % x.ndim for a in ax)
+        if x.split not in ax:
+            split = x.split - sum(1 for a in ax if a < x.split) if not keepdims else x.split
+    out = DNDarray(result, tuple(np.shape(result)), types.canonical_heat_type(result.dtype), split, x.device, x.comm)
+    return _ensure_split(out, split)
+
+
+def vector_norm(x: DNDarray, axis=None, keepdims=False, ord=2) -> DNDarray:
+    """Vector norm (reference: basics.py:2323)."""
+    return norm(x, axis=axis, keepdims=keepdims, ord=ord)
+
+
+def projection(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Projection of a onto b (reference: basics.py:1619)."""
+    if a.ndim != 1 or b.ndim != 1:
+        raise RuntimeError("projection requires 1-D vectors")
+    scale = dot(a, b).larray / dot(b, b).larray
+    result = b.larray * scale
+    out = DNDarray(result, b.shape, types.canonical_heat_type(result.dtype), b.split, b.device, b.comm)
+    return _ensure_split(out, b.split)
+
+
+def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=None, out=None) -> DNDarray:
+    """Sum of diagonal elements (reference: basics.py:1643)."""
+    sanitation.sanitize_in(a)
+    result = jnp.trace(a.larray, offset=offset, axis1=axis1, axis2=axis2)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    ret = DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, a.device, a.comm)
+    if out is not None:
+        out.larray = ret.larray
+        return out
+    return ret
+
+
+def transpose(a: DNDarray, axes=None) -> DNDarray:
+    """Axis permutation (reference: basics.py:2065 — local permute + split
+    remap; identical metadata story here)."""
+    sanitation.sanitize_in(a)
+    if axes is None:
+        axes = tuple(reversed(range(a.ndim)))
+    else:
+        axes = tuple(ax % a.ndim for ax in axes)
+    result = jnp.transpose(a.larray, axes)
+    split = axes.index(a.split) if a.split is not None else None
+    out = DNDarray(result, tuple(result.shape), a.dtype, split, a.device, a.comm)
+    return _ensure_split(out, split)
+
+
+def tril(m: DNDarray, k: int = 0) -> DNDarray:
+    """Lower triangle (reference: basics.py:2205 via __tri_op:2135)."""
+    sanitation.sanitize_in(m)
+    arr = m.larray
+    added = arr.ndim == 1
+    if added:
+        arr = jnp.broadcast_to(arr, (arr.shape[0], arr.shape[0]))
+    result = jnp.tril(arr, k=k)
+    split = m.split if not added else (None if m.split is None else m.split)
+    out = DNDarray(result, tuple(result.shape), m.dtype, split, m.device, m.comm)
+    return _ensure_split(out, split)
+
+
+def triu(m: DNDarray, k: int = 0) -> DNDarray:
+    """Upper triangle (reference: basics.py:2228)."""
+    sanitation.sanitize_in(m)
+    arr = m.larray
+    added = arr.ndim == 1
+    if added:
+        arr = jnp.broadcast_to(arr, (arr.shape[0], arr.shape[0]))
+    result = jnp.triu(arr, k=k)
+    split = m.split
+    out = DNDarray(result, tuple(result.shape), m.dtype, split, m.device, m.comm)
+    return _ensure_split(out, split)
+
+
+def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
+    """Conjugated dot product (reference: basics.py:2250)."""
+    result = jnp.vdot(x1.larray, x2.larray)
+    return DNDarray(result, (), types.canonical_heat_type(result.dtype), None, x1.device, x1.comm)
+
+
+def vecdot(x1: DNDarray, x2: DNDarray, axis: int = -1, keepdims: bool = False) -> DNDarray:
+    """Vector dot along an axis (reference: basics.py:2286)."""
+    from .. import _operations
+
+    mul = _operations._binary_op(jnp.multiply, x1, x2)
+    from .. import arithmetics
+
+    return arithmetics.sum(mul, axis=axis, keepdims=keepdims)
+
+
+def cross(x1: DNDarray, x2: DNDarray, axis: int = -1) -> DNDarray:
+    """Cross product (reference: basics.py:47)."""
+    sanitation.sanitize_in(x1)
+    sanitation.sanitize_in(x2)
+    result = jnp.cross(x1.larray, x2.larray, axis=axis)
+    out = DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), x1.split, x1.device, x1.comm)
+    return _ensure_split(out, x1.split if x1.split is not None and x1.split < result.ndim else None)
+
+
+# operator/method bindings
+DNDarray.__matmul__ = lambda self, other: matmul(self, other)
+DNDarray.transpose = lambda self, axes=None: transpose(self, axes)

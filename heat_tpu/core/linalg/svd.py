@@ -1,0 +1,57 @@
+"""Distributed SVD.
+
+The reference ships only an empty placeholder (heat/core/linalg/svd.py:1-5).
+The rebuild does better: a real tall-skinny SVD via the TSQR tree
+(A = QR, R = U' S V^T, U = Q U') — one all-gather beyond the local work —
+plus XLA's native SVD for replicated inputs.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+
+from .. import sanitation, types
+from ..dndarray import DNDarray, _ensure_split
+from .basics import matmul
+from .qr import qr
+
+__all__ = ["svd"]
+
+SVD = collections.namedtuple("SVD", "U, S, V")
+
+
+def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
+    """Singular value decomposition ``a = U @ diag(S) @ V.T``.
+
+    For split=0 tall-skinny inputs: TSQR + small SVD of R (communication: one
+    all-gather of n×n panels). Otherwise XLA's SVD on the global array.
+    """
+    sanitation.sanitize_in(a)
+    if a.ndim != 2:
+        raise ValueError(f"svd requires a 2-D array, got {a.ndim}-D")
+    if full_matrices:
+        raise NotImplementedError("full_matrices=True is not supported (thin SVD only)")
+
+    m, n = a.shape
+    if a.split == 0 and m >= n * a.comm.size and a.comm.size > 1:
+        Q, R = qr(a)
+        u_small, s, vt = jnp.linalg.svd(R.larray, full_matrices=False)
+        if not compute_uv:
+            return DNDarray(s, tuple(s.shape), types.canonical_heat_type(s.dtype), None, a.device, a.comm)
+        U = matmul(Q, DNDarray(u_small, tuple(u_small.shape), types.canonical_heat_type(u_small.dtype), None, a.device, a.comm))
+        S = DNDarray(s, tuple(s.shape), types.canonical_heat_type(s.dtype), None, a.device, a.comm)
+        V = DNDarray(vt.T, tuple(vt.T.shape), types.canonical_heat_type(vt.dtype), None, a.device, a.comm)
+        return SVD(U, S, V)
+
+    arr = a.larray
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        arr = arr.astype(jnp.float32)
+    u, s, vt = jnp.linalg.svd(arr, full_matrices=False)
+    if not compute_uv:
+        return DNDarray(s, tuple(s.shape), types.canonical_heat_type(s.dtype), None, a.device, a.comm)
+    U = DNDarray(u, tuple(u.shape), types.canonical_heat_type(u.dtype), a.split, a.device, a.comm)
+    S = DNDarray(s, tuple(s.shape), types.canonical_heat_type(s.dtype), None, a.device, a.comm)
+    V = DNDarray(vt.T, tuple(vt.T.shape), types.canonical_heat_type(vt.dtype), None, a.device, a.comm)
+    return SVD(_ensure_split(U, a.split), S, V)

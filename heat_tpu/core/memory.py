@@ -1,0 +1,31 @@
+"""Memory layout helpers (reference: heat/core/memory.py).
+
+``copy`` (:13) and ``sanitize_memory_layout`` (:42). XLA owns physical layout
+on TPU (tiled, not strided), so C/F order is metadata-only here.
+"""
+
+from __future__ import annotations
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x: DNDarray) -> DNDarray:
+    """A (logical) copy of the array (reference: memory.py:13). jax arrays are
+    immutable, so a metadata-fresh wrapper suffices."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, got {type(x)}")
+    import jax.numpy as jnp
+
+    return DNDarray(
+        jnp.copy(x.larray), x.shape, x.dtype, x.split, x.device, x.comm
+    )
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Memory-order handling (reference: memory.py:42). TPU layouts are
+    XLA-tiled; ``order`` is accepted for API parity and ignored."""
+    if order not in ("C", "F"):
+        raise ValueError(f"order must be 'C' or 'F', got {order!r}")
+    return x

@@ -1,0 +1,84 @@
+"""Printing (reference: heat/core/printing.py).
+
+The reference gathers shards to rank 0 with a summarization threshold (:62)
+and torch-style formatting (:267). Here the global array is directly
+printable; we keep the reference's API: ``global_printing``,
+``local_printing``, ``print0``, ``set_printoptions``/``get_printoptions``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = [
+    "get_printoptions",
+    "global_printing",
+    "local_printing",
+    "print0",
+    "set_printoptions",
+]
+
+# summarization threshold mirrors torch's default used by the reference
+_printoptions = {"threshold": 1000, "edgeitems": 3, "precision": 4, "linewidth": 120}
+_LOCAL_PRINTING = False
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
+    """Configure printing (reference: printing.py:150)."""
+    if profile == "default":
+        _printoptions.update(threshold=1000, edgeitems=3, precision=4)
+    elif profile == "short":
+        _printoptions.update(threshold=1000, edgeitems=2, precision=2)
+    elif profile == "full":
+        _printoptions.update(threshold=np.inf, edgeitems=3, precision=4)
+    for key, val in (("precision", precision), ("threshold", threshold), ("edgeitems", edgeitems), ("linewidth", linewidth)):
+        if val is not None:
+            _printoptions[key] = val
+
+
+def get_printoptions() -> dict:
+    """Current printing configuration (reference: printing.py:~140)."""
+    return dict(_printoptions)
+
+
+def local_printing() -> None:
+    """Print only process-local data (reference: printing.py:30)."""
+    global _LOCAL_PRINTING
+    _LOCAL_PRINTING = True
+
+
+def global_printing() -> None:
+    """Print the global array (default; reference: printing.py:62)."""
+    global _LOCAL_PRINTING
+    _LOCAL_PRINTING = False
+
+
+def print0(*args, **kwargs) -> None:
+    """Print on process 0 only (reference: printing.py:100)."""
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+def __str__(dndarray) -> str:
+    """Render a DNDarray (reference: printing.py:187 __str__)."""
+    opts = _printoptions
+    with np.printoptions(
+        precision=opts["precision"],
+        threshold=opts["threshold"] if np.isfinite(opts["threshold"]) else 2**63 - 1,
+        edgeitems=opts["edgeitems"],
+        linewidth=opts["linewidth"],
+    ):
+        if _LOCAL_PRINTING:
+            shards = dndarray.lshards()
+            body = np.array2string(shards[0]) if shards else "[]"
+        elif dndarray.size > opts["threshold"]:
+            # summarized: numpy handles edgeitem elision on the gathered view
+            body = np.array2string(np.asarray(dndarray.larray))
+        else:
+            body = np.array2string(np.asarray(dndarray.larray))
+    return (
+        f"DNDarray({body}, dtype=ht.{dndarray.dtype.__name__}, "
+        f"device={dndarray.device}, split={dndarray.split})"
+    )

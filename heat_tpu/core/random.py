@@ -1,0 +1,191 @@
+"""Parallel random number generation (reference: heat/core/random.py, 1077 LoC).
+
+The reference hand-implements Threefry-2x32/2x64 in torch integer ops
+(random.py:876-1053) with a global ``(seed, counter)`` state so that results
+are **identical for any number of ranks** (``__counter_sequence``,
+random.py:55-201).  JAX's native PRNG *is* counter-based Threefry with global
+semantics: a jitted sharded ``jax.random.*`` call produces the same logical
+array for any mesh, each device generating only its own shard
+(partitionable threefry).  So the whole module reduces to key management that
+mirrors the reference's stateful API.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple, Type, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import devices, types
+from .dndarray import DNDarray, _physical_dim, _to_physical
+from .factories import _finalize
+from ..parallel.mesh import sanitize_comm
+from .stride_tricks import sanitize_shape
+
+__all__ = [
+    "get_state",
+    "normal",
+    "permutation",
+    "rand",
+    "randint",
+    "randn",
+    "random",
+    "random_integer",
+    "random_sample",
+    "randperm",
+    "ranf",
+    "sample",
+    "seed",
+    "set_state",
+    "standard_normal",
+]
+
+# global state mirroring the reference's (seed, counter) pair (random.py:39-43)
+__seed: int = int(time.time() * 256) % (2**31)
+__counter: int = 0
+
+
+def __next_key() -> jax.Array:
+    """Derive the next key from (seed, counter) and advance the counter —
+    the stateful facade over JAX's splittable keys."""
+    global __counter
+    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter)
+    __counter += 1
+    return key
+
+
+def seed(new_seed: Optional[int] = None) -> None:
+    """Re-seed the generator (reference: random.py:772)."""
+    global __seed, __counter
+    if new_seed is None:
+        new_seed = int(time.time() * 256) % (2**31)
+    __seed = int(new_seed)
+    __counter = 0
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """Return the generator state (reference: random.py:203). Tuple layout
+    matches the reference: (name, seed, counter, gauss_flag, gauss_cache)."""
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple) -> None:
+    """Restore generator state (reference: random.py:790)."""
+    global __seed, __counter
+    if not isinstance(state, tuple) or len(state) not in (3, 5):
+        raise ValueError("state must be a tuple of length 3 or 5")
+    if state[0] != "Threefry":
+        raise ValueError(f"unknown generator {state[0]!r}")
+    __seed = int(state[1])
+    __counter = int(state[2])
+
+
+def _sharded_sample(shape, split, device, comm, sampler, jdtype) -> DNDarray:
+    """Generate a sharded sample: jit with out_shardings makes each device
+    generate only its shard while the logical result is mesh-size-invariant."""
+    shape = sanitize_shape(shape)
+    comm = sanitize_comm(comm)
+    key = __next_key()
+    split_ = split if len(shape) else None
+    # mesh-size invariance: always sample at the LOGICAL shape (the physical
+    # pad, if any, is zeros appended afterwards), so the same seed gives the
+    # same global numbers for any mesh — the reference's core RNG contract
+    if split_ is not None and shape[split_] % comm.size != 0:
+        garray = sampler(key, shape, jdtype)
+        garray = _to_physical(garray, shape, split_, comm)
+    else:
+        sharding = comm.sharding(split_, len(shape))
+        fn = jax.jit(lambda k: sampler(k, shape, jdtype), out_shardings=sharding)
+        garray = fn(key)
+    return DNDarray(
+        garray, shape, types.canonical_heat_type(garray.dtype),
+        split_, devices.sanitize_device(device), comm,
+    )
+
+
+def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (reference: random.py:404)."""
+    shape = d if len(d) else ()
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    jdtype = types.canonical_heat_type(dtype).jax_type()
+    if not shape:
+        return _sharded_sample((), None, device, comm, jax.random.uniform, jdtype)
+    return _sharded_sample(shape, split, device, comm, jax.random.uniform, jdtype)
+
+
+random_sample = rand
+random = rand
+ranf = rand
+sample = rand
+
+
+def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples (reference: random.py:592 — Kundu transform
+    there, true Gaussian sampling here)."""
+    shape = d if len(d) else ()
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    jdtype = types.canonical_heat_type(dtype).jax_type()
+    return _sharded_sample(shape, split, device, comm, jax.random.normal, jdtype)
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Normal(mean, std) samples (reference: random.py:268)."""
+    if shape is None:
+        shape = ()
+    base = randn(*((shape,) if isinstance(shape, (tuple, list)) else (shape,)), dtype=dtype, split=split, device=device, comm=comm)
+    m = mean.larray if isinstance(mean, DNDarray) else mean
+    s = std.larray if isinstance(std, DNDarray) else std
+    result = base.larray * s + m
+    return DNDarray(result, base.shape, base.dtype, base.split, base.device, base.comm)
+
+
+def randint(low, high=None, size=None, dtype=types.int32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform integers in [low, high) (reference: random.py:481)."""
+    if high is None:
+        low, high = 0, low
+    if size is None:
+        size = ()
+    if isinstance(size, int):
+        size = (size,)
+    jdtype = types.canonical_heat_type(dtype).jax_type()
+    return _sharded_sample(
+        size, split, device, comm,
+        lambda k, s, d: jax.random.randint(k, s, int(low), int(high), dtype=d),
+        jdtype,
+    )
+
+
+random_integer = randint
+
+
+def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of arange(n) (reference: random.py:649)."""
+    key = __next_key()
+    comm_ = sanitize_comm(comm)
+    perm = jax.random.permutation(key, int(n)).astype(types.canonical_heat_type(dtype).jax_type())
+    return _finalize(perm, split, device, comm_)
+
+
+def permutation(x, split=None, device=None, comm=None) -> DNDarray:
+    """Randomly permute a sequence or shuffle an array along axis 0
+    (reference: random.py:326)."""
+    key = __next_key()
+    if isinstance(x, (int, np.integer)):
+        return randperm(int(x), split=split, device=device, comm=comm)
+    if isinstance(x, DNDarray):
+        shuffled = jax.random.permutation(key, x.larray, axis=0)
+        out = DNDarray(shuffled, x.shape, x.dtype, x.split, x.device, x.comm)
+        from .dndarray import _ensure_split
+
+        return _ensure_split(out, x.split)
+    arr = jnp.asarray(x)
+    return _finalize(jax.random.permutation(key, arr, axis=0), split, device, sanitize_comm(comm))

@@ -1,0 +1,84 @@
+"""Rounding, absolute value, clipping (reference: heat/core/rounding.py,
+454 LoC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sign", "sgn", "trunc"]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:
+    """Elementwise absolute value (reference: rounding.py abs)."""
+    result = _operations._local_op(jnp.abs, x, out=out, no_cast=True)
+    if dtype is not None:
+        result = result.astype(dtype, copy=False)
+    return result
+
+
+absolute = abs
+
+
+def ceil(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.ceil, x, out=out)
+
+
+def clip(x, min=None, max=None, out=None) -> DNDarray:
+    """Clamp values to [min, max]."""
+    if min is None and max is None:
+        raise ValueError("either min or max must be given")
+    lo = min.larray if isinstance(min, DNDarray) else min
+    hi = max.larray if isinstance(max, DNDarray) else max
+    return _operations._local_op(lambda t: jnp.clip(t, lo, hi), x, out=out, no_cast=True)
+
+
+def fabs(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.fabs, x, out=out)
+
+
+def floor(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.floor, x, out=out)
+
+
+def modf(x, out=None):
+    """Fractional and integral parts (reference: rounding.py modf)."""
+    from . import sanitation
+
+    sanitation.sanitize_in(x)
+    frac, integral = jnp.modf(x.larray.astype(jnp.float32) if not jnp.issubdtype(x.larray.dtype, jnp.inexact) else x.larray)
+    from .dndarray import _ensure_split
+
+    f = _ensure_split(
+        DNDarray(frac, x.shape, types.canonical_heat_type(frac.dtype), x.split, x.device, x.comm),
+        x.split,
+    )
+    i = _ensure_split(
+        DNDarray(integral, x.shape, types.canonical_heat_type(integral.dtype), x.split, x.device, x.comm),
+        x.split,
+    )
+    if out is not None:
+        out[0].larray = f.larray
+        out[1].larray = i.larray
+        return out
+    return (f, i)
+
+
+def round(x, decimals: int = 0, out=None, dtype=None) -> DNDarray:
+    result = _operations._local_op(lambda t: jnp.round(t, decimals=decimals), x, out=out)
+    if dtype is not None:
+        result = result.astype(dtype, copy=False)
+    return result
+
+
+def sign(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.sign, x, out=out, no_cast=True)
+
+
+sgn = sign
+
+
+def trunc(x, out=None) -> DNDarray:
+    return _operations._local_op(jnp.trunc, x, out=out)

@@ -1,0 +1,91 @@
+"""Input/output validation (reference: heat/core/sanitation.py).
+
+``sanitize_distribution`` (:31-157) — the reference's redistribution workhorse
+— is declarative here: aligning an operand to a target's layout is a
+``resplit`` (one device_put). ``sanitize_in`` (:159), ``sanitize_out`` (:259),
+``sanitize_lshape`` (:213), ``scalar_to_1d`` (:375) keep their roles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = [
+    "sanitize_in",
+    "sanitize_out",
+    "sanitize_distribution",
+    "sanitize_lshape",
+    "scalar_to_1d",
+    "sanitize_in_tensor",
+]
+
+
+def sanitize_in(x) -> None:
+    """Raise unless ``x`` is a DNDarray (reference: sanitation.py:159)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input must be a DNDarray, got {type(x)}")
+
+
+def sanitize_in_tensor(x):
+    """Accept DNDarray or array-like, return the jax value."""
+    import jax.numpy as jnp
+
+    if isinstance(x, DNDarray):
+        return x.larray
+    return jnp.asarray(x)
+
+
+def sanitize_out(
+    out: DNDarray,
+    output_shape: Tuple[int, ...],
+    output_split: Optional[int],
+    output_device,
+    output_comm=None,
+) -> None:
+    """Validate an ``out=`` target (reference: sanitation.py:259)."""
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out to be None or a DNDarray, got {type(out)}")
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"expected out shape {tuple(output_shape)}, got {tuple(out.shape)}")
+    # reference semantics (sanitation.py:259): out adopts the result's
+    # distribution; invalidate cached shard metadata along with it
+    object.__setattr__(out, "_DNDarray__split", output_split)
+    object.__setattr__(out, "_DNDarray__gshape", tuple(output_shape))
+    object.__setattr__(out, "_DNDarray__lshape_map", None)
+
+
+def sanitize_distribution(*args: DNDarray, target: DNDarray, diff_map=None):
+    """Align every input to the target's split (reference: sanitation.py:31).
+
+    Under GSPMD this is a metadata-level resplit; the data movement happens in
+    the compiled computation."""
+    out = []
+    for x in args:
+        sanitize_in(x)
+        if x.split == target.split or x.ndim == 0:
+            out.append(x)
+        else:
+            from . import manipulations
+
+            out.append(manipulations.resplit(x, target.split))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def sanitize_lshape(array: DNDarray, tensor) -> None:
+    """Validate that a local tensor matches the array's shard shape
+    (reference: sanitation.py:213)."""
+    if tuple(tensor.shape) != tuple(array.lshape):
+        raise ValueError(f"local tensor shape {tuple(tensor.shape)} != lshape {array.lshape}")
+
+
+def scalar_to_1d(x: DNDarray) -> DNDarray:
+    """Reshape a scalar DNDarray to shape (1,) (reference: sanitation.py:375)."""
+    if x.ndim == 0:
+        return DNDarray(
+            x.larray.reshape(1), (1,), x.dtype, None, x.device, x.comm
+        )
+    return x

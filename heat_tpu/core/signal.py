@@ -1,0 +1,62 @@
+"""Signal processing (reference: heat/core/signal.py, 206 LoC).
+
+``convolve`` (:16) is the reference's showcase of halo exchange
+(``a.get_halo``): each rank pads its shard with neighbor data, then runs a
+local conv.  On TPU the roles invert: we express the *global* convolution
+(``lax.conv_general_dilated``) over the sharded input and XLA's partitioner
+materializes the halos on ICI — same dataflow, no hand-written exchange.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import sanitation, types
+from .dndarray import DNDarray, _ensure_split
+
+__all__ = ["convolve"]
+
+
+def convolve(a: DNDarray, v, mode: str = "full") -> DNDarray:
+    """1-D discrete convolution (reference: signal.py:16; modes full/same/valid)."""
+    sanitation.sanitize_in(a)
+    if isinstance(v, DNDarray):
+        kernel = v.larray
+    else:
+        kernel = jnp.asarray(v)
+    if a.ndim != 1 or kernel.ndim != 1:
+        raise ValueError("convolve only supports 1-D inputs")
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"unsupported mode {mode!r}")
+
+    arr = a.larray
+    promoted = jnp.promote_types(arr.dtype, kernel.dtype)
+    if not jnp.issubdtype(promoted, jnp.inexact):
+        compute_dtype = jnp.float32
+    else:
+        compute_dtype = promoted
+
+    n, k = arr.shape[0], kernel.shape[0]
+    if mode == "full":
+        pad = (k - 1, k - 1)
+    elif mode == "same":
+        # numpy centers the 'same' window left-heavy for even kernels
+        pad = (k // 2, (k - 1) // 2)
+    else:
+        pad = (0, 0)
+
+    # express as a NCW conv so XLA shards the spatial dim and inserts halos
+    lhs = arr.astype(compute_dtype).reshape(1, 1, n)
+    rhs = jnp.flip(kernel.astype(compute_dtype)).reshape(1, 1, k)
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[pad],
+        dimension_numbers=("NCW", "OIW", "NCW"),
+    )[0, 0]
+    if jnp.issubdtype(promoted, jnp.integer):
+        out = jnp.round(out).astype(promoted)
+    result = DNDarray(
+        out, tuple(out.shape), types.canonical_heat_type(out.dtype),
+        a.split, a.device, a.comm,
+    )
+    return _ensure_split(result, a.split)

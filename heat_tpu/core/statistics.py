@@ -1,0 +1,292 @@
+"""Statistical operations (reference: heat/core/statistics.py, 2000 LoC).
+
+The reference's hand-built distributed machinery — custom MPI reduce ops
+carrying (value, index) pairs for argmax/argmin (statistics.py:1338, 1374),
+pairwise moment merging for mean/var across ranks (``__merge_moments``,
+:1044, Bennett et al.) — all collapses into single jnp reductions that XLA
+partitions and all-reduces over ICI.  ``median``/``percentile`` use the
+sort-based global path the reference uses, via XLA's distributed-capable sort.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import _operations, sanitation, types
+from .dndarray import DNDarray, _ensure_split
+from .stride_tricks import sanitize_axis
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "average",
+    "bincount",
+    "bucketize",
+    "cov",
+    "digitize",
+    "histc",
+    "histogram",
+    "kurtosis",
+    "max",
+    "maximum",
+    "mean",
+    "median",
+    "min",
+    "minimum",
+    "percentile",
+    "skew",
+    "std",
+    "var",
+]
+
+
+def argmax(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Index of the maximum (reference: statistics.py:46 — twin-payload MPI op
+    there, one jnp.argmax here)."""
+    return _operations._reduce_op(
+        lambda t, axis=None, keepdims=False: jnp.argmax(t, axis=axis, keepdims=keepdims),
+        x, axis=axis, out=out, keepdims=keepdims,
+    )
+
+
+def argmin(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Index of the minimum (reference: statistics.py:117)."""
+    return _operations._reduce_op(
+        lambda t, axis=None, keepdims=False: jnp.argmin(t, axis=axis, keepdims=keepdims),
+        x, axis=axis, out=out, keepdims=keepdims,
+    )
+
+
+def average(x, axis=None, weights=None, returned=False):
+    """Weighted average (reference: statistics.py:189)."""
+    sanitation.sanitize_in(x)
+    w = weights.larray if isinstance(weights, DNDarray) else weights
+    result, wsum = jnp.average(x.larray, axis=axis, weights=w, returned=True)
+    axis_s = sanitize_axis(x.shape, axis)
+    split = x.split
+    if split is not None:
+        if axis_s is None or split == axis_s:
+            split = None
+        elif axis_s is not None and axis_s < split:
+            split -= 1
+    avg = _ensure_split(
+        DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, x.device, x.comm),
+        split,
+    )
+    if returned:
+        ws = _ensure_split(
+            DNDarray(jnp.broadcast_to(wsum, result.shape), tuple(result.shape), types.canonical_heat_type(wsum.dtype), split, x.device, x.comm),
+            split,
+        )
+        return avg, ws
+    return avg
+
+
+def bincount(x, weights=None, minlength: int = 0) -> DNDarray:
+    """Occurrence counts of non-negative ints (reference: statistics.py:323)."""
+    sanitation.sanitize_in(x)
+    w = weights.larray if isinstance(weights, DNDarray) else weights
+    result = jnp.bincount(x.larray, weights=w, minlength=minlength)
+    return DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, x.device, x.comm)
+
+
+def bucketize(input, boundaries, right: bool = False, out=None) -> DNDarray:
+    """Bucket index of each element (reference: statistics.py:394)."""
+    sanitation.sanitize_in(input)
+    b = boundaries.larray if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
+    # torch.bucketize: right=False → boundaries[i-1] < v <= boundaries[i]
+    # (= searchsorted side='left'); right=True → side='right'
+    side = "right" if right else "left"
+    result = jnp.searchsorted(b, input.larray, side=side)
+    wrapped = _ensure_split(
+        DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), input.split, input.device, input.comm),
+        input.split,
+    )
+    if out is not None:
+        out.larray = wrapped.larray
+        return out
+    return wrapped
+
+
+def cov(m, y=None, rowvar: bool = True, bias: bool = False, ddof=None) -> DNDarray:
+    """Covariance matrix (reference: statistics.py:467)."""
+    sanitation.sanitize_in(m)
+    yv = y.larray if isinstance(y, DNDarray) else y
+    result = jnp.cov(m.larray, yv, rowvar=rowvar, bias=bias, ddof=ddof)
+    result = jnp.atleast_2d(result)
+    return DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, m.device, m.comm)
+
+
+def digitize(x, bins, right: bool = False) -> DNDarray:
+    """Bin index of each element (reference: statistics.py:542)."""
+    sanitation.sanitize_in(x)
+    b = bins.larray if isinstance(bins, DNDarray) else jnp.asarray(bins)
+    result = jnp.digitize(x.larray, b, right=right)
+    return _ensure_split(
+        DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), x.split, x.device, x.comm),
+        x.split,
+    )
+
+
+def histc(input, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
+    """Histogram with equal-width bins (reference: statistics.py:617)."""
+    sanitation.sanitize_in(input)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo = float(jnp.min(input.larray))
+        hi = float(jnp.max(input.larray))
+    hist, _ = jnp.histogram(input.larray, bins=bins, range=(lo, hi))
+    hist = hist.astype(input.dtype.jax_type())
+    wrapped = DNDarray(hist, tuple(hist.shape), input.dtype, None, input.device, input.comm)
+    if out is not None:
+        out.larray = hist
+        return out
+    return wrapped
+
+
+def histogram(a, bins: int = 10, range=None, weights=None, density=None):
+    """NumPy-style histogram (reference: statistics.py:680)."""
+    sanitation.sanitize_in(a)
+    w = weights.larray if isinstance(weights, DNDarray) else weights
+    hist, edges = jnp.histogram(a.larray, bins=bins, range=range, weights=w, density=density)
+    h = DNDarray(hist, tuple(hist.shape), types.canonical_heat_type(hist.dtype), None, a.device, a.comm)
+    e = DNDarray(edges, tuple(edges.shape), types.canonical_heat_type(edges.dtype), None, a.device, a.comm)
+    return h, e
+
+
+def kurtosis(x, axis=None, unbiased: bool = True, Fischer: bool = True) -> DNDarray:
+    """Kurtosis (reference: statistics.py:728 — pairwise moment merging there,
+    a fused global moment computation here)."""
+    return _moment_stat(x, axis, order=4, unbiased=unbiased, fischer=Fischer)
+
+
+def skew(x, axis=None, unbiased: bool = True) -> DNDarray:
+    """Skewness (reference: statistics.py:1679)."""
+    return _moment_stat(x, axis, order=3, unbiased=unbiased)
+
+
+def _moment_stat(x, axis, order: int, unbiased: bool, fischer: bool = True) -> DNDarray:
+    sanitation.sanitize_in(x)
+    arr = x.larray
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        arr = arr.astype(jnp.float32)
+    axis_s = sanitize_axis(x.shape, axis)
+    n = x.size if axis_s is None else x.shape[axis_s]
+    mu = jnp.mean(arr, axis=axis_s, keepdims=True)
+    centered = arr - mu
+    m2 = jnp.mean(centered**2, axis=axis_s)
+    mk = jnp.mean(centered**order, axis=axis_s)
+    if order == 3:
+        g = mk / (m2**1.5)
+        if unbiased and n > 2:
+            g = g * np.sqrt(n * (n - 1)) / (n - 2)
+    else:
+        g = mk / (m2**2)
+        if unbiased and n > 3:
+            g = ((n**2 - 1) * g - 3 * (n - 1) ** 2) / ((n - 2) * (n - 3)) + 3
+        if fischer:
+            g = g - 3
+    result = jnp.asarray(g)
+    split = x.split
+    if split is not None:
+        if axis_s is None or split == axis_s:
+            split = None
+        elif axis_s < split:
+            split -= 1
+    if result.ndim == 0:
+        split = None
+    return _ensure_split(
+        DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, x.device, x.comm),
+        split,
+    )
+
+
+def max(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Maximum (reference: statistics.py:782)."""
+    return _operations._reduce_op(jnp.max, x, axis=axis, out=out, keepdims=keepdims)
+
+
+def maximum(x1, x2, out=None, where=None) -> DNDarray:
+    """Elementwise maximum (reference: statistics.py:841)."""
+    return _operations._binary_op(jnp.maximum, x1, x2, out=out, where=where)
+
+
+def mean(x, axis=None) -> DNDarray:
+    """Arithmetic mean (reference: statistics.py:892 — merged-moments
+    Allreduce there, one partitioned jnp.mean here)."""
+    return _operations._reduce_op(
+        lambda t, axis=None, keepdims=False: jnp.mean(
+            t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
+            axis=axis, keepdims=keepdims,
+        ),
+        x, axis=axis,
+    )
+
+
+def median(x, axis=None, keepdims=False) -> DNDarray:
+    """Median via the global-sort path (reference: statistics.py:1018)."""
+    return percentile(x, 50.0, axis=axis, keepdims=keepdims)
+
+
+def min(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Minimum (reference: statistics.py:1115)."""
+    return _operations._reduce_op(jnp.min, x, axis=axis, out=out, keepdims=keepdims)
+
+
+def minimum(x1, x2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.minimum, x1, x2, out=out, where=where)
+
+
+def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims=False) -> DNDarray:
+    """q-th percentile along axis (reference: statistics.py:1409)."""
+    sanitation.sanitize_in(x)
+    axis_s = sanitize_axis(x.shape, axis)
+    qv = q.larray if isinstance(q, DNDarray) else q
+    result = jnp.percentile(
+        x.larray.astype(jnp.float32) if not jnp.issubdtype(x.larray.dtype, jnp.inexact) else x.larray,
+        jnp.asarray(qv), axis=axis_s, method=interpolation, keepdims=keepdims,
+    )
+    wrapped = DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, x.device, x.comm
+    )
+    if out is not None:
+        out.larray = wrapped.larray
+        return out
+    return wrapped
+
+
+def std(x, axis=None, ddof: int = 0) -> DNDarray:
+    """Standard deviation (reference: statistics.py:1724)."""
+    return _operations._reduce_op(
+        lambda t, axis=None, keepdims=False: jnp.std(
+            t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
+            axis=axis, ddof=ddof, keepdims=keepdims,
+        ),
+        x, axis=axis,
+    )
+
+
+def var(x, axis=None, ddof: int = 0) -> DNDarray:
+    """Variance (reference: statistics.py:1857 — Bennett merged moments there,
+    one partitioned jnp.var here)."""
+    return _operations._reduce_op(
+        lambda t, axis=None, keepdims=False: jnp.var(
+            t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
+            axis=axis, ddof=ddof, keepdims=keepdims,
+        ),
+        x, axis=axis,
+    )
+
+
+# method bindings (the reference binds these on DNDarray too)
+DNDarray.argmax = lambda self, axis=None, out=None, keepdims=False: argmax(self, axis, out, keepdims)
+DNDarray.argmin = lambda self, axis=None, out=None, keepdims=False: argmin(self, axis, out, keepdims)
+DNDarray.max = lambda self, axis=None, out=None, keepdims=False: max(self, axis, out, keepdims)
+DNDarray.min = lambda self, axis=None, out=None, keepdims=False: min(self, axis, out, keepdims)
+DNDarray.mean = lambda self, axis=None: mean(self, axis)
+DNDarray.std = lambda self, axis=None, ddof=0: std(self, axis, ddof)
+DNDarray.var = lambda self, axis=None, ddof=0: var(self, axis, ddof)

@@ -1,0 +1,130 @@
+"""Shape/axis/slice sanitation helpers.
+
+TPU-native reimplementation of the reference's helpers (heat/core/stride_tricks.py:12-210):
+``broadcast_shape``, ``broadcast_shapes``, ``sanitize_axis``, ``sanitize_shape``,
+``sanitize_slice``. Pure Python math — no device interaction.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "broadcast_shape",
+    "broadcast_shapes",
+    "sanitize_axis",
+    "sanitize_shape",
+    "sanitize_slice",
+]
+
+
+def broadcast_shape(shape_a: Tuple[int, ...], shape_b: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Infer the NumPy broadcast output shape of two operand shapes.
+
+    Raises ``ValueError`` when the shapes are not broadcastable
+    (reference: heat/core/stride_tricks.py:12).
+    """
+    try:
+        return tuple(np.broadcast_shapes(tuple(shape_a), tuple(shape_b)))
+    except ValueError:
+        raise ValueError(
+            f"operands could not be broadcast, input shapes {tuple(shape_a)} {tuple(shape_b)}"
+        )
+
+
+def broadcast_shapes(*shapes: Tuple[int, ...]) -> Tuple[int, ...]:
+    """N-ary version of :func:`broadcast_shape`."""
+    try:
+        return tuple(np.broadcast_shapes(*[tuple(s) for s in shapes]))
+    except ValueError:
+        raise ValueError(f"operands could not be broadcast, input shapes {shapes}")
+
+
+def sanitize_axis(
+    shape: Tuple[int, ...], axis: Optional[Union[int, Tuple[int, ...]]]
+) -> Optional[Union[int, Tuple[int, ...]]]:
+    """Normalize ``axis`` to non-negative int (or tuple of ints) valid for ``shape``.
+
+    Mirrors heat/core/stride_tricks.py:72: ``None`` passes through; negative axes wrap;
+    out-of-bounds raises ``ValueError``; non-int raises ``TypeError``.
+    """
+    ndim = len(shape)
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        axes = []
+        for ax in axis:
+            if not isinstance(ax, (int, np.integer)):
+                raise TypeError(f"axis must be None or int or tuple of ints, got {type(ax)}")
+            ax = int(ax)
+            if ax < -ndim or ax >= max(ndim, 1):
+                raise ValueError(f"axis {ax} is out of bounds for array of dimension {ndim}")
+            axes.append(ax % max(ndim, 1) if ndim > 0 else 0)
+        if len(set(axes)) != len(axes):
+            raise ValueError("duplicate axes given")
+        return tuple(axes)
+    if not isinstance(axis, (int, np.integer)):
+        raise TypeError(f"axis must be None or int or tuple of ints, got {type(axis)}")
+    axis = int(axis)
+    if ndim == 0:
+        # scalars: only axis in {-1, 0} allowed, normalizes to None-like 0
+        if axis not in (-1, 0):
+            raise ValueError(f"axis {axis} is out of bounds for scalar")
+        return 0
+    if axis < -ndim or axis >= ndim:
+        raise ValueError(f"axis {axis} is out of bounds for array of dimension {ndim}")
+    return axis % ndim
+
+
+def sanitize_shape(shape, lval: int = 0) -> Tuple[int, ...]:
+    """Normalize a user-supplied shape to a tuple of non-negative ints.
+
+    Accepts ints, iterables of ints, and numpy integers (reference:
+    heat/core/stride_tricks.py:135). ``lval`` is the lower bound for entries
+    (0 by default; -1 to allow a single wildcard dimension as in ``reshape``).
+    """
+    if isinstance(shape, (int, np.integer)):
+        shape = (int(shape),)
+    try:
+        shape = tuple(shape)
+    except TypeError:
+        raise TypeError(f"expected sequence object with length >= 0 or a single integer, got {shape}")
+    out = []
+    for dim in shape:
+        if isinstance(dim, (np.ndarray,)) and dim.ndim == 0:
+            dim = dim.item()
+        if not isinstance(dim, (int, np.integer)):
+            # accept 0-d jax arrays / things with __index__
+            try:
+                dim = int(dim)
+            except Exception:
+                raise TypeError(f"expected integer dimension, got {type(dim)}")
+        dim = int(dim)
+        if dim < lval:
+            raise ValueError(f"negative dimensions are not allowed, got {dim}")
+        out.append(dim)
+    return tuple(out)
+
+
+def sanitize_slice(sl: slice, max_dim: int) -> slice:
+    """Resolve a slice's start/stop/step against a dimension size ``max_dim``
+    (reference: heat/core/stride_tricks.py:180)."""
+    if not isinstance(sl, slice):
+        raise TypeError("can only be used for slices")
+    start, stop, step = sl.indices(max_dim)
+    return slice(start, stop, step)
+
+
+def sanitize_axes_for_reduction(
+    shape: Tuple[int, ...], axis
+) -> Tuple[Tuple[int, ...], bool]:
+    """Return (tuple of normalized axes, was_none) for a reduction over ``axis``."""
+    if axis is None:
+        return tuple(range(len(shape))), True
+    axis = sanitize_axis(shape, axis)
+    if isinstance(axis, int):
+        return (axis,), False
+    return tuple(axis), False

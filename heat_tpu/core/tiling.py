@@ -1,0 +1,72 @@
+"""Tile decompositions (reference: heat/core/tiling.py, 1257 LoC).
+
+The reference's ``SplitTiles`` (:14-330) feeds ``resplit_``'s hand-written
+shuffle and ``SquareDiagTiles`` (:331-1257) anchors the tiled QR scheduler.
+Under GSPMD neither is needed for data movement — resplit is a device_put and
+QR is a shard_map TSQR tree (heat_tpu/core/linalg/qr.py).  What remains useful
+is the *tile map math* itself (which global index range lives on which
+device), so ``SplitTiles`` survives as a metadata-only object.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles"]
+
+
+class SplitTiles:
+    """Per-device tile decomposition of a DNDarray (metadata only; reference:
+    tiling.py:14-330)."""
+
+    def __init__(self, arr: DNDarray):
+        self.__arr = arr
+        comm = arr.comm
+        n = comm.size
+        ndim = arr.ndim
+        # tile border indices per dimension: along the split dim, the device
+        # chunk borders; elsewhere the whole dim
+        borders = []
+        for dim in range(ndim):
+            if dim == arr.split:
+                edges = [0]
+                for r in range(n):
+                    off, lshape, _ = comm.chunk(arr.shape, arr.split, rank=r)
+                    edges.append(off + lshape[arr.split])
+                borders.append(np.asarray(edges))
+            else:
+                borders.append(np.asarray([0, arr.shape[dim]]))
+        self.__borders = borders
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_dimensions(self) -> list:
+        """Per-dimension tile sizes (reference: tiling.py tile_dimensions)."""
+        return [np.diff(b) for b in self.__borders]
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Which device owns each tile along the split dim (reference:
+        tiling.py tile_locations)."""
+        arr = self.__arr
+        n = arr.comm.size
+        if arr.split is None:
+            return np.zeros(1, dtype=np.int64)
+        return np.arange(n, dtype=np.int64)
+
+    def tile_ranges(self, rank: int) -> Tuple[slice, ...]:
+        """Global index slices of device ``rank``'s tile."""
+        arr = self.__arr
+        _, _, slices = arr.comm.chunk(arr.shape, arr.split, rank=rank)
+        return slices
+
+    def __getitem__(self, key):
+        """Read a tile's data by device rank along the split dim."""
+        return self.__arr.larray[self.tile_ranges(key if isinstance(key, int) else key[0])]

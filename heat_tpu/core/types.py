@@ -1,0 +1,457 @@
+"""heat_tpu type system: a NumPy-like dtype class lattice over JAX dtypes.
+
+TPU-native re-design of the reference's type system (heat/core/types.py:64-420):
+the same class hierarchy (``datatype`` → ``bool``/``number`` →
+``integer``/``floating``/``complexfloating`` → concrete types), each concrete
+type exposing the backing JAX dtype via :meth:`datatype.jax_type` (the
+reference's ``torch_type()``), plus ``canonical_heat_type`` (types.py:495),
+``heat_type_of`` (:565), ``can_cast`` (:671), ``promote_types`` (:836),
+``result_type`` (:868, scalar-aware), ``finfo``/``iinfo`` (:950/:1005).
+
+TPU-first additions: :class:`bfloat16` and :class:`float16` are first-class
+members of the lattice (the MXU's native matmul dtype is bf16).
+
+64-bit policy: JAX's ``jax_enable_x64`` flag decides whether 64-bit types are
+real or silently demoted. ``heat_tpu`` enables x64 when running on CPU (test
+parity with NumPy) and leaves it off on TPU, where float64 would be emulated;
+dtype metadata on arrays always reflects the *actual* on-device dtype.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Iterator, Tuple, Type, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "datatype",
+    "bool",
+    "bool_",
+    "number",
+    "integer",
+    "signedinteger",
+    "unsignedinteger",
+    "floating",
+    "complexfloating",
+    "int8",
+    "byte",
+    "int16",
+    "short",
+    "int32",
+    "int",
+    "int64",
+    "long",
+    "uint8",
+    "ubyte",
+    "float16",
+    "half",
+    "bfloat16",
+    "float32",
+    "float",
+    "float_",
+    "float64",
+    "double",
+    "complex64",
+    "cfloat",
+    "complex128",
+    "cdouble",
+    "flexible",
+    "canonical_heat_type",
+    "heat_type_is_exact",
+    "heat_type_is_inexact",
+    "heat_type_is_complexfloating",
+    "heat_type_of",
+    "issubdtype",
+    "can_cast",
+    "promote_types",
+    "result_type",
+    "iscomplex",
+    "isreal",
+    "finfo",
+    "iinfo",
+]
+
+
+class datatype:
+    """Base class of the dtype lattice (reference: heat/core/types.py:64).
+
+    Concrete subclasses act both as dtype tags (``ht.float32``) and as casting
+    constructors: ``ht.float32(x)`` builds a DNDarray of that type.
+    """
+
+    _jnp_type = None
+    _char = "??"
+    _nbytes = 0
+
+    def __new__(cls, *value, device=None, comm=None, split=None):
+        from . import factories
+
+        if cls._jnp_type is None:
+            raise TypeError(f"cannot instantiate abstract type {cls.__name__}")
+        if len(value) == 0:
+            value = ((0,) if not issubclass(cls, complexfloating) else (0j,),)
+            value = value[0]
+        elif len(value) == 1:
+            value = value[0]
+        else:
+            value = list(value)
+        return factories.array(value, dtype=cls, device=device, comm=comm, split=split)
+
+    @classmethod
+    def jax_type(cls):
+        """The backing jnp dtype (reference's ``torch_type()``, types.py:86)."""
+        if cls._jnp_type is None:
+            raise TypeError(f"abstract type {cls.__name__} has no JAX dtype")
+        return cls._jnp_type
+
+    # reference-compat alias so generic code written against Heat still works
+    torch_type = jax_type
+
+    @classmethod
+    def char(cls) -> str:
+        """Short identifier (reference: types.py:94)."""
+        return cls._char
+
+    @classmethod
+    def nbytes(cls) -> builtins.int:
+        return cls._nbytes
+
+
+class bool(datatype):
+    """Boolean (reference: types.py:142)."""
+
+    _jnp_type = jnp.bool_
+    _char = "u1"
+    _nbytes = 1
+
+
+bool_ = bool
+
+
+class number(datatype):
+    """Abstract numeric type (reference: types.py:151)."""
+
+
+class integer(number):
+    """Abstract integer (reference: types.py:157)."""
+
+
+class signedinteger(integer):
+    """Abstract signed integer (reference: types.py:163)."""
+
+
+class unsignedinteger(integer):
+    """Abstract unsigned integer (reference: types.py:169)."""
+
+
+class floating(number):
+    """Abstract float (reference: types.py:175)."""
+
+
+class complexfloating(number):
+    """Abstract complex (reference: types.py:181)."""
+
+
+class flexible(datatype):
+    """Abstract non-numeric (kept for API parity; reference: types.py:187)."""
+
+
+class int8(signedinteger):
+    _jnp_type = jnp.int8
+    _char = "i1"
+    _nbytes = 1
+
+
+byte = int8
+
+
+class int16(signedinteger):
+    _jnp_type = jnp.int16
+    _char = "i2"
+    _nbytes = 2
+
+
+short = int16
+
+
+class int32(signedinteger):
+    _jnp_type = jnp.int32
+    _char = "i4"
+    _nbytes = 4
+
+
+int = int32  # canonical heat int alias (reference aliases int→int32, types.py:266)
+
+
+class int64(signedinteger):
+    _jnp_type = jnp.int64
+    _char = "i8"
+    _nbytes = 8
+
+
+long = int64
+
+
+class uint8(unsignedinteger):
+    _jnp_type = jnp.uint8
+    _char = "u1"
+    _nbytes = 1
+
+
+ubyte = uint8
+
+
+class float16(floating):
+    """IEEE half precision — TPU-first addition (not in the reference)."""
+
+    _jnp_type = jnp.float16
+    _char = "f2"
+    _nbytes = 2
+
+
+half = float16
+
+
+class bfloat16(floating):
+    """Brain float — the MXU's native matmul dtype. TPU-first addition."""
+
+    _jnp_type = jnp.bfloat16
+    _char = "bf2"
+    _nbytes = 2
+
+
+class float32(floating):
+    _jnp_type = jnp.float32
+    _char = "f4"
+    _nbytes = 4
+
+
+float = float32
+float_ = float32
+
+
+class float64(floating):
+    _jnp_type = jnp.float64
+    _char = "f8"
+    _nbytes = 8
+
+
+double = float64
+
+
+class complex64(complexfloating):
+    _jnp_type = jnp.complex64
+    _char = "c8"
+    _nbytes = 8
+
+
+cfloat = complex64
+
+
+class complex128(complexfloating):
+    _jnp_type = jnp.complex128
+    _char = "c16"
+    _nbytes = 16
+
+
+cdouble = complex128
+
+
+# ----------------------------------------------------------------- mappings
+_NP_TO_HEAT = {
+    np.dtype(np.bool_): bool,
+    np.dtype(np.int8): int8,
+    np.dtype(np.int16): int16,
+    np.dtype(np.int32): int32,
+    np.dtype(np.int64): int64,
+    np.dtype(np.uint8): uint8,
+    np.dtype(np.uint16): int32,  # promoted: no uint16 in lattice (reference parity)
+    np.dtype(np.uint32): int64,
+    np.dtype(np.uint64): int64,
+    np.dtype(np.float16): float16,
+    np.dtype(ml_dtypes.bfloat16): bfloat16,
+    np.dtype(np.float32): float32,
+    np.dtype(np.float64): float64,
+    np.dtype(np.complex64): complex64,
+    np.dtype(np.complex128): complex128,
+}
+
+_PY_TO_HEAT = {
+    builtins.bool: bool,
+    builtins.int: int64,
+    builtins.float: float32,
+    builtins.complex: complex64,
+}
+
+
+def _all_concrete() -> Iterator[Type[datatype]]:
+    stack = [datatype]
+    while stack:
+        cls = stack.pop()
+        stack.extend(cls.__subclasses__())
+        if cls._jnp_type is not None:
+            yield cls
+
+
+def canonical_heat_type(a_type: Any) -> Type[datatype]:
+    """Normalize any dtype-like to its canonical heat type (reference:
+    types.py:495). Accepts heat types, python scalar types, numpy/jnp dtypes,
+    dtype strings."""
+    if isinstance(a_type, type) and issubclass(a_type, datatype):
+        if a_type._jnp_type is None:
+            raise TypeError(f"abstract type {a_type.__name__} is not a canonical type")
+        return a_type
+    if a_type in _PY_TO_HEAT:
+        return _PY_TO_HEAT[a_type]
+    # strings like "float32", "f4", numpy dtypes, jnp dtypes
+    if isinstance(a_type, str):
+        for cls in _all_concrete():
+            if cls.__name__ == a_type or cls._char == a_type:
+                return cls
+    try:
+        np_dtype = np.dtype(a_type)
+    except TypeError:
+        raise TypeError(f"data type {a_type!r} not understood")
+    if np_dtype in _NP_TO_HEAT:
+        return _NP_TO_HEAT[np_dtype]
+    raise TypeError(f"data type {a_type!r} not understood")
+
+
+def heat_type_of(obj: Any) -> Type[datatype]:
+    """Infer the heat type of an array-like (reference: types.py:565)."""
+    from .dndarray import DNDarray
+
+    if isinstance(obj, DNDarray):
+        return obj.dtype
+    if isinstance(obj, (type(None),)):
+        raise TypeError("cannot infer heat type of None")
+    if type(obj) in _PY_TO_HEAT:
+        return _PY_TO_HEAT[type(obj)]
+    if hasattr(obj, "dtype"):
+        return canonical_heat_type(obj.dtype)
+    if isinstance(obj, (list, tuple)):
+        return canonical_heat_type(np.asarray(obj).dtype)
+    raise TypeError(f"cannot infer heat type of {type(obj)}")
+
+
+def heat_type_is_exact(ht_dtype: Type[datatype]) -> builtins.bool:
+    """True for integer/bool types (reference: types.py:~640)."""
+    return issubclass(ht_dtype, integer) or ht_dtype is bool
+
+
+def heat_type_is_inexact(ht_dtype: Type[datatype]) -> builtins.bool:
+    return issubclass(ht_dtype, (floating, complexfloating))
+
+
+def heat_type_is_complexfloating(ht_dtype: Type[datatype]) -> builtins.bool:
+    return issubclass(ht_dtype, complexfloating)
+
+
+def issubdtype(arg1: Any, arg2: Any) -> builtins.bool:
+    """NumPy-style subtype check over the heat lattice."""
+    if not (isinstance(arg1, type) and issubclass(arg1, datatype)):
+        arg1 = canonical_heat_type(arg1)
+    if not (isinstance(arg2, type) and issubclass(arg2, datatype)):
+        if arg2 in (number, integer, floating, complexfloating, signedinteger, unsignedinteger):
+            pass
+        else:
+            arg2 = canonical_heat_type(arg2)
+    return issubclass(arg1, arg2)
+
+
+def _np_equivalent(ht_dtype: Type[datatype]):
+    t = ht_dtype.jax_type()
+    return np.dtype(t)
+
+
+def can_cast(from_: Any, to: Any, casting: str = "safe") -> builtins.bool:
+    """NumPy-semantics castability over heat types (reference: types.py:671)."""
+    if not isinstance(from_, type):
+        # scalars / arrays: use their inferred type
+        try:
+            from_ = heat_type_of(from_)
+        except TypeError:
+            from_ = canonical_heat_type(from_)
+    else:
+        from_ = canonical_heat_type(from_)
+    to = canonical_heat_type(to)
+    return np.can_cast(_np_equivalent(from_), _np_equivalent(to), casting=casting)
+
+
+def promote_types(type1: Any, type2: Any) -> Type[datatype]:
+    """Smallest common safe type (reference: types.py:836). Delegates to
+    jnp.promote_types so bfloat16 participates correctly."""
+    t1 = canonical_heat_type(type1)
+    t2 = canonical_heat_type(type2)
+    return canonical_heat_type(jnp.promote_types(t1.jax_type(), t2.jax_type()))
+
+
+def result_type(*operands: Any) -> Type[datatype]:
+    """Scalar-aware promotion across DNDarrays/scalars/dtypes (reference:
+    types.py:868). Delegates to jnp.result_type (NumPy promotion rules with
+    weak scalar types)."""
+    from .dndarray import DNDarray
+
+    args = []
+    for op in operands:
+        if isinstance(op, DNDarray):
+            args.append(op.larray)
+        elif isinstance(op, type) and issubclass(op, datatype):
+            args.append(op.jax_type())
+        else:
+            args.append(op)
+    return canonical_heat_type(jnp.result_type(*args))
+
+
+def iscomplex(x) -> "Any":
+    """Elementwise imaginary-part-nonzero test (reference: types.py:764)."""
+    from . import _operations
+
+    return _operations._local_op(jnp.iscomplex, x, no_cast=True)
+
+
+def isreal(x) -> "Any":
+    """Elementwise real test (reference: types.py:786)."""
+    from . import _operations
+
+    return _operations._local_op(jnp.isreal, x, no_cast=True)
+
+
+class finfo:
+    """Float machine limits (reference: types.py:950)."""
+
+    def __new__(cls, ht_dtype: Type[datatype]):
+        ht_dtype = canonical_heat_type(ht_dtype)
+        if not issubclass(ht_dtype, (floating, complexfloating)):
+            raise TypeError(f"data type {ht_dtype} not inexact")
+        info = jnp.finfo(ht_dtype.jax_type())
+        obj = object.__new__(cls)
+        obj.bits = info.bits
+        obj.eps = builtins.float(info.eps)
+        obj.max = builtins.float(info.max)
+        obj.min = builtins.float(info.min)
+        obj.tiny = builtins.float(info.tiny)
+        obj.resolution = builtins.float(getattr(info, "resolution", info.eps))
+        return obj
+
+
+class iinfo:
+    """Integer machine limits (reference: types.py:1005)."""
+
+    def __new__(cls, ht_dtype: Type[datatype]):
+        ht_dtype = canonical_heat_type(ht_dtype)
+        if not issubclass(ht_dtype, (integer,)) and ht_dtype is not bool:
+            raise TypeError(f"data type {ht_dtype} not integral")
+        info = jnp.iinfo(ht_dtype.jax_type()) if ht_dtype is not bool else None
+        obj = object.__new__(cls)
+        if info is None:
+            obj.bits, obj.max, obj.min = 8, 1, 0
+        else:
+            obj.bits = info.bits
+            obj.max = builtins.int(info.max)
+            obj.min = builtins.int(info.min)
+        return obj

@@ -1,0 +1,19 @@
+"""Version information for heat_tpu.
+
+Mirrors the reference's version layout (heat/core/version.py:1-16) with a
+major/minor/micro/extension split.
+"""
+
+major: int = 0
+"""Major version number."""
+minor: int = 1
+"""Minor version number."""
+micro: int = 0
+"""Micro version number."""
+extension: str = "dev"
+"""Extension tag."""
+
+if not extension:
+    __version__ = f"{major}.{minor}.{micro}"
+else:
+    __version__ = f"{major}.{minor}.{micro}-{extension}"

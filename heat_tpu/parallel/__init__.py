@@ -1,0 +1,33 @@
+"""Device-mesh and sharding layer — the TPU-native replacement for the reference's
+MPI communication backend (heat/core/communication.py).
+
+On TPU there is no explicit message-passing backend: a :class:`Communication`
+object owns a ``jax.sharding.Mesh`` and a distinguished *split* axis name; all
+"collectives" are emitted by XLA from sharded computations (``psum`` /
+``all_gather`` / ``all_to_all`` / ``ppermute`` over ICI/DCN).  An explicit
+facade of shard_map-level collectives lives in :mod:`heat_tpu.parallel.collectives`
+for the algorithms that control their own schedule (TSQR, ring cdist, halo
+exchange).
+"""
+
+from .mesh import (
+    Communication,
+    MeshComm,
+    get_comm,
+    use_comm,
+    sanitize_comm,
+    world,
+    local_mesh,
+)
+from . import collectives
+
+__all__ = [
+    "Communication",
+    "MeshComm",
+    "get_comm",
+    "use_comm",
+    "sanitize_comm",
+    "world",
+    "local_mesh",
+    "collectives",
+]

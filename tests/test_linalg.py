@@ -1,0 +1,140 @@
+"""Linear algebra tests (reference models: heat/core/linalg/tests/
+test_basics.py — full matmul split matrix — and test_qr.py)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+class TestMatmul(TestCase):
+    def test_matmul_split_matrix(self):
+        """The reference tests every (a.split, b.split) case of its dispatch
+        table (test_basics.py, 2155 LoC); here the table is GSPMD but the
+        contract is identical."""
+        rng = np.random.default_rng(101)
+        da = rng.random((17, 13)).astype(np.float32)
+        db = rng.random((13, 11)).astype(np.float32)
+        expected = da @ db
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                a, b = ht.array(da, split=sa), ht.array(db, split=sb)
+                r = ht.matmul(a, b)
+                self.assert_array_equal(r, expected, rtol=1e-4)
+        self.assertEqual(ht.matmul(ht.array(da, split=0), ht.array(db)).split, 0)
+        self.assertEqual(ht.matmul(ht.array(da), ht.array(db, split=1)).split, 1)
+
+    def test_matmul_operator(self):
+        rng = np.random.default_rng(103)
+        da = rng.random((8, 6)).astype(np.float32)
+        db = rng.random((6, 4)).astype(np.float32)
+        r = ht.array(da, split=0) @ ht.array(db, split=0)
+        self.assert_array_equal(r, da @ db, rtol=1e-4)
+
+    def test_dot_vdot_outer(self):
+        rng = np.random.default_rng(107)
+        va = rng.random(50).astype(np.float32)
+        vb = rng.random(50).astype(np.float32)
+        a, b = ht.array(va, split=0), ht.array(vb, split=0)
+        self.assertAlmostEqual(float(ht.dot(a, b)), float(va @ vb), places=3)
+        self.assertAlmostEqual(float(ht.vdot(a, b)), float(np.vdot(va, vb)), places=3)
+        self.assert_array_equal(ht.outer(a, b), np.outer(va, vb), rtol=1e-5)
+
+    def test_transpose_tril_triu(self):
+        data = np.random.default_rng(109).random((6, 4)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            t = x.T
+            self.assert_array_equal(t, data.T)
+            if split is not None:
+                self.assertEqual(t.split, 1 - split)
+            self.assert_array_equal(ht.tril(x), np.tril(data))
+            self.assert_array_equal(ht.triu(x, 1), np.triu(data, 1))
+
+    def test_norm_trace(self):
+        data = np.random.default_rng(113).random((5, 5)).astype(np.float32)
+        x = ht.array(data, split=0)
+        self.assertAlmostEqual(float(ht.norm(x)), float(np.linalg.norm(data)), places=4)
+        self.assertAlmostEqual(float(ht.trace(x)), float(np.trace(data)), places=4)
+        v = ht.array(data[0], split=0)
+        self.assertAlmostEqual(
+            float(ht.vector_norm(v)), float(np.linalg.norm(data[0])), places=4
+        )
+
+    def test_det_inv(self):
+        data = np.random.default_rng(127).random((4, 4)).astype(np.float64) + 2 * np.eye(4)
+        x = ht.array(data, split=0)
+        self.assertAlmostEqual(float(ht.linalg.det(x)), float(np.linalg.det(data)), places=4)
+        self.assert_array_equal(ht.linalg.inv(x), np.linalg.inv(data), rtol=1e-4, atol=1e-6)
+
+
+class TestQR(TestCase):
+    def test_tsqr_tall_skinny(self):
+        """split=0 tall-skinny path — the TSQR tree (reference: qr.py split=0
+        tiled path)."""
+        rng = np.random.default_rng(131)
+        data = rng.random((64, 6)).astype(np.float64)
+        x = ht.array(data, split=0)
+        q, r = ht.linalg.qr(x)
+        self.assertEqual(q.split, 0)
+        qn, rn = q.numpy(), r.numpy()
+        # reconstruction
+        np.testing.assert_allclose(qn @ rn, data, rtol=1e-8, atol=1e-8)
+        # orthonormality
+        np.testing.assert_allclose(qn.T @ qn, np.eye(6), atol=1e-8)
+        # R upper-triangular with non-negative diagonal
+        np.testing.assert_allclose(rn, np.triu(rn), atol=1e-10)
+        self.assertTrue((np.diag(rn) >= 0).all())
+
+    def test_qr_replicated_and_split1(self):
+        rng = np.random.default_rng(137)
+        data = rng.random((20, 12)).astype(np.float64)
+        for split in (None, 1):
+            x = ht.array(data, split=split)
+            q, r = ht.linalg.qr(x)
+            np.testing.assert_allclose(q.numpy() @ r.numpy(), data, rtol=1e-8, atol=1e-8)
+
+    def test_qr_matches_across_splits(self):
+        """Same factorization regardless of distribution (sign-normalized)."""
+        rng = np.random.default_rng(139)
+        data = rng.random((48, 4)).astype(np.float64)
+        q0, r0 = ht.linalg.qr(ht.array(data, split=0))
+        q1, r1 = ht.linalg.qr(ht.array(data))
+        np.testing.assert_allclose(r0.numpy(), r1.numpy(), rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(q0.numpy(), q1.numpy(), rtol=1e-6, atol=1e-8)
+
+
+class TestSVD(TestCase):
+    def test_tall_skinny_svd(self):
+        rng = np.random.default_rng(149)
+        data = rng.random((64, 5)).astype(np.float64)
+        x = ht.array(data, split=0)
+        u, s, v = ht.linalg.svd(x)
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, data, rtol=1e-8, atol=1e-8
+        )
+        np.testing.assert_allclose(s.numpy(), np.linalg.svd(data, compute_uv=False), rtol=1e-8)
+
+
+class TestSolvers(TestCase):
+    def test_cg(self):
+        rng = np.random.default_rng(151)
+        n = 24
+        M = rng.random((n, n))
+        A = M @ M.T + n * np.eye(n)
+        b = rng.random(n)
+        x = ht.linalg.cg(
+            ht.array(A, split=0), ht.array(b, split=0), ht.zeros((n,), dtype=ht.float64, split=0)
+        )
+        np.testing.assert_allclose(x.numpy(), np.linalg.solve(A, b), rtol=1e-5, atol=1e-6)
+
+    def test_lanczos(self):
+        rng = np.random.default_rng(157)
+        n = 16
+        M = rng.random((n, n))
+        A = (M + M.T) / 2
+        V, T = ht.linalg.lanczos(ht.array(A, split=0), m=n)
+        Vn, Tn = V.numpy(), T.numpy()
+        # V orthonormal, T tridiagonal, V T V^T ≈ A
+        np.testing.assert_allclose(Vn.T @ Vn, np.eye(n), atol=1e-6)
+        np.testing.assert_allclose(Vn @ Tn @ Vn.T, A, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,403 @@
+"""Op surface tests: arithmetics, relational, logical, elementwise math,
+statistics, manipulations (reference models: heat/core/tests/
+test_arithmetics.py, test_statistics.py, test_manipulations.py —
+split-matrix convention: every op over split None/0/1 and odd shapes)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+class TestArithmetics(TestCase):
+    def test_binary_ops_split_matrix(self):
+        rng = np.random.default_rng(7)
+        da = rng.random((9, 5)).astype(np.float32) + 1.0
+        db = rng.random((9, 5)).astype(np.float32) + 1.0
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                a, b = ht.array(da, split=sa), ht.array(db, split=sb)
+                self.assert_array_equal(a + b, da + db)
+                self.assert_array_equal(a - b, da - db)
+                self.assert_array_equal(a * b, da * db)
+                self.assert_array_equal(a / b, da / db, rtol=1e-5)
+
+    def test_scalar_operands(self):
+        data = np.arange(10, dtype=np.float32)
+        x = ht.array(data, split=0)
+        self.assert_array_equal(x + 2, data + 2)
+        self.assert_array_equal(2 + x, 2 + data)
+        self.assert_array_equal(2 * x - 1, 2 * data - 1)
+        self.assert_array_equal(x**2, data**2)
+        self.assert_array_equal(1 / (x + 1), 1 / (data + 1), rtol=1e-5)
+
+    def test_broadcasting(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        b = np.arange(3, dtype=np.float32)
+        x = ht.array(a, split=0)
+        y = ht.array(b)
+        self.assert_array_equal(x + y, a + b)
+        self.assertEqual((x + y).split, 0)
+        z = ht.array(b, split=0)
+        self.assert_array_equal(x + z, a + b)
+
+    def test_int_ops(self):
+        da = np.arange(1, 11)
+        db = np.arange(10, 0, -1)
+        a, b = ht.array(da, split=0), ht.array(db, split=0)
+        self.assert_array_equal(a // b, da // db)
+        self.assert_array_equal(a % b, da % db)
+        self.assert_array_equal(a & b, da & db)
+        self.assert_array_equal(a | b, da | db)
+        self.assert_array_equal(a ^ b, da ^ db)
+        self.assert_array_equal(a << 1, da << 1)
+        self.assert_array_equal(a >> 1, da >> 1)
+        self.assert_array_equal(~a, ~da)
+
+    def test_reductions(self):
+        data = np.random.default_rng(3).random((7, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assert_array_equal(ht.sum(x, axis=0), data.sum(axis=0), rtol=1e-5)
+            self.assert_array_equal(ht.sum(x, axis=1), data.sum(axis=1), rtol=1e-5)
+            self.assertAlmostEqual(float(ht.sum(x)), float(data.sum()), places=3)
+            self.assert_array_equal(ht.prod(x, axis=0), data.prod(axis=0), rtol=1e-4)
+            self.assert_array_equal(
+                ht.sum(x, axis=0, keepdims=True), data.sum(axis=0, keepdims=True), rtol=1e-5
+            )
+
+    def test_cumops(self):
+        data = np.random.default_rng(5).random((6, 4)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assert_array_equal(ht.cumsum(x, 0), data.cumsum(axis=0), rtol=1e-5)
+            self.assert_array_equal(ht.cumprod(x, 1), data.cumprod(axis=1), rtol=1e-5)
+
+    def test_diff(self):
+        data = np.random.default_rng(6).random((8, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assert_array_equal(ht.diff(x, axis=0), np.diff(data, axis=0), rtol=1e-5)
+            self.assert_array_equal(ht.diff(x, axis=1), np.diff(data, axis=1), rtol=1e-5)
+
+
+class TestRelationalLogical(TestCase):
+    def test_comparisons(self):
+        da = np.array([[1.0, 2.0], [3.0, 4.0]])
+        db = np.array([[4.0, 2.0], [1.0, 4.0]])
+        for split in (None, 0, 1):
+            a, b = ht.array(da, split=split), ht.array(db, split=split)
+            self.assert_array_equal(a == b, da == db)
+            self.assert_array_equal(a != b, da != db)
+            self.assert_array_equal(a < b, da < db)
+            self.assert_array_equal(a >= b, da >= db)
+        self.assertTrue(ht.equal(ht.array(da), ht.array(da.copy())))
+        self.assertFalse(ht.equal(ht.array(da), ht.array(db)))
+
+    def test_all_any_allclose(self):
+        x = ht.array(np.array([[True, True], [True, False]]), split=0)
+        self.assertFalse(bool(ht.all(x)))
+        self.assertTrue(bool(ht.any(x)))
+        self.assert_array_equal(ht.all(x, axis=1), np.array([True, False]))
+        a = ht.ones((4, 4), split=0)
+        self.assertTrue(ht.allclose(a, a + 1e-9))
+
+    def test_isnan_isinf(self):
+        data = np.array([1.0, np.nan, np.inf, -np.inf])
+        x = ht.array(data, split=0)
+        self.assert_array_equal(ht.isnan(x), np.isnan(data))
+        self.assert_array_equal(ht.isinf(x), np.isinf(data))
+        self.assert_array_equal(ht.isfinite(x), np.isfinite(data))
+
+
+class TestElementwiseMath(TestCase):
+    def test_exponential_trig(self):
+        data = np.random.default_rng(9).random((5, 5)).astype(np.float32) + 0.5
+        for fn, nfn in [
+            (ht.exp, np.exp), (ht.log, np.log), (ht.sqrt, np.sqrt),
+            (ht.sin, np.sin), (ht.cos, np.cos), (ht.tanh, np.tanh),
+        ]:
+            x = ht.array(data, split=0)
+            self.assert_array_equal(fn(x), nfn(data), rtol=1e-5)
+
+    def test_int_input_promotes(self):
+        x = ht.arange(1, 5, split=0)
+        r = ht.sqrt(x)
+        self.assertTrue(ht.issubdtype(r.dtype, ht.floating))
+
+    def test_rounding(self):
+        data = np.array([-1.7, -0.2, 0.2, 1.7], dtype=np.float32)
+        x = ht.array(data, split=0)
+        self.assert_array_equal(ht.floor(x), np.floor(data))
+        self.assert_array_equal(ht.ceil(x), np.ceil(data))
+        self.assert_array_equal(ht.trunc(x), np.trunc(data))
+        self.assert_array_equal(ht.abs(x), np.abs(data))
+        self.assert_array_equal(ht.clip(x, -1, 1), np.clip(data, -1, 1))
+        self.assert_array_equal(ht.sign(x), np.sign(data))
+
+
+class TestStatistics(TestCase):
+    def test_mean_var_std(self):
+        data = np.random.default_rng(11).random((9, 6)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assertAlmostEqual(float(ht.mean(x)), float(data.mean()), places=4)
+            self.assert_array_equal(ht.mean(x, axis=0), data.mean(axis=0), rtol=1e-5)
+            self.assert_array_equal(ht.var(x, axis=1), data.var(axis=1), rtol=1e-4)
+            self.assert_array_equal(ht.std(x, axis=0), data.std(axis=0), rtol=1e-4)
+
+    def test_min_max_arg(self):
+        data = np.random.default_rng(13).random((8, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assertAlmostEqual(float(ht.max(x)), float(data.max()), places=5)
+            self.assertAlmostEqual(float(ht.min(x)), float(data.min()), places=5)
+            self.assert_array_equal(ht.argmax(x, axis=0), data.argmax(axis=0))
+            self.assert_array_equal(ht.argmin(x, axis=1), data.argmin(axis=1))
+            self.assertEqual(int(ht.argmax(x)), int(data.argmax()))
+
+    def test_maximum_minimum(self):
+        da = np.random.default_rng(17).random((6, 4)).astype(np.float32)
+        db = np.random.default_rng(19).random((6, 4)).astype(np.float32)
+        a, b = ht.array(da, split=0), ht.array(db, split=0)
+        self.assert_array_equal(ht.maximum(a, b), np.maximum(da, db))
+        self.assert_array_equal(ht.minimum(a, b), np.minimum(da, db))
+
+    def test_median_percentile(self):
+        data = np.random.default_rng(23).random(101).astype(np.float32)
+        x = ht.array(data, split=0)
+        self.assertAlmostEqual(float(ht.median(x)), float(np.median(data)), places=5)
+        self.assertAlmostEqual(
+            float(ht.percentile(x, 25.0)), float(np.percentile(data, 25.0)), places=4
+        )
+
+    def test_average_cov(self):
+        data = np.random.default_rng(29).random((7, 4)).astype(np.float64)
+        w = np.random.default_rng(31).random(7)
+        x = ht.array(data, split=0)
+        self.assert_array_equal(
+            ht.average(x, axis=0, weights=ht.array(w, split=0)),
+            np.average(data, axis=0, weights=w),
+            rtol=1e-5,
+        )
+        self.assert_array_equal(ht.cov(x.T), np.atleast_2d(np.cov(data.T)), rtol=1e-5)
+
+    def test_histogram_bincount_digitize(self):
+        data = np.random.default_rng(37).integers(0, 10, 50)
+        x = ht.array(data, split=0)
+        self.assert_array_equal(ht.bincount(x), np.bincount(data))
+        fdata = data.astype(np.float32)
+        h, edges = ht.histogram(ht.array(fdata, split=0), bins=5)
+        nh, nedges = np.histogram(fdata, bins=5)
+        self.assert_array_equal(h, nh)
+        bins = np.array([2.0, 4.0, 8.0])
+        self.assert_array_equal(
+            ht.digitize(ht.array(fdata, split=0), bins), np.digitize(fdata, bins)
+        )
+
+    def test_skew_kurtosis(self):
+        data = np.random.default_rng(41).random(200).astype(np.float64)
+        x = ht.array(data, split=0)
+        import scipy.stats as sps
+
+        self.assertAlmostEqual(float(ht.skew(x)), float(sps.skew(data, bias=False)), places=4)
+        self.assertAlmostEqual(
+            float(ht.kurtosis(x)), float(sps.kurtosis(data, bias=False)), places=4
+        )
+
+
+class TestManipulations(TestCase):
+    def test_concatenate(self):
+        rng = np.random.default_rng(43)
+        da = rng.random((5, 4)).astype(np.float32)
+        db = rng.random((3, 4)).astype(np.float32)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                r = ht.concatenate([ht.array(da, split=sa), ht.array(db, split=sb)], axis=0)
+                self.assert_array_equal(r, np.concatenate([da, db], axis=0))
+        dc = rng.random((5, 2)).astype(np.float32)
+        r = ht.concatenate([ht.array(da, split=0), ht.array(dc, split=0)], axis=1)
+        self.assert_array_equal(r, np.concatenate([da, dc], axis=1))
+
+    def test_reshape(self):
+        data = np.arange(24, dtype=np.float32)
+        for split in (None, 0):
+            x = ht.array(data, split=split)
+            r = ht.reshape(x, (6, 4))
+            self.assert_array_equal(r, data.reshape(6, 4))
+            r2 = ht.reshape(x, (2, 3, 4))
+            self.assert_array_equal(r2, data.reshape(2, 3, 4))
+
+    def test_stack_hstack_vstack(self):
+        rng = np.random.default_rng(47)
+        da = rng.random((4, 3)).astype(np.float32)
+        db = rng.random((4, 3)).astype(np.float32)
+        a, b = ht.array(da, split=0), ht.array(db, split=0)
+        self.assert_array_equal(ht.stack([a, b]), np.stack([da, db]))
+        self.assert_array_equal(ht.vstack([a, b]), np.vstack([da, db]))
+        self.assert_array_equal(ht.hstack([a, b]), np.hstack([da, db]))
+
+    def test_sort_topk(self):
+        data = np.random.default_rng(53).random((7, 9)).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(data, split=split)
+            v, i = ht.sort(x, axis=1)
+            self.assert_array_equal(v, np.sort(data, axis=1))
+            self.assert_array_equal(i, np.argsort(data, axis=1, kind="stable"))
+        v, i = ht.topk(ht.array(data, split=0), 3, dim=1)
+        nv = -np.sort(-data, axis=1)[:, :3]
+        self.assert_array_equal(v, nv)
+
+    def test_unique(self):
+        data = np.array([3, 1, 2, 3, 1, 9], dtype=np.int64)
+        x = ht.array(data, split=0)
+        u = ht.unique(x, sorted=True)
+        self.assert_array_equal(u, np.unique(data))
+        u, inv = ht.unique(x, return_inverse=True)
+        nu, ninv = np.unique(data, return_inverse=True)
+        self.assert_array_equal(u, nu)
+        self.assert_array_equal(inv, ninv)
+
+    def test_squeeze_expand(self):
+        data = np.random.default_rng(59).random((1, 5, 1, 3)).astype(np.float32)
+        x = ht.array(data, split=1)
+        s = ht.squeeze(x)
+        self.assert_array_equal(s, data.squeeze())
+        self.assertEqual(s.split, 0)
+        e = ht.expand_dims(ht.array(data.squeeze(), split=0), 0)
+        self.assert_array_equal(e, data.squeeze()[None])
+        self.assertEqual(e.split, 1)
+
+    def test_flip_roll_rot90(self):
+        data = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            self.assert_array_equal(ht.flip(x, 0), np.flip(data, 0))
+            self.assert_array_equal(ht.fliplr(x), np.fliplr(data))
+            self.assert_array_equal(ht.roll(x, 1, axis=0), np.roll(data, 1, axis=0))
+            self.assert_array_equal(ht.rot90(x), np.rot90(data))
+
+    def test_pad_repeat_tile(self):
+        data = np.arange(6, dtype=np.float32).reshape(2, 3)
+        x = ht.array(data, split=0)
+        self.assert_array_equal(
+            ht.pad(x, ((1, 1), (0, 0))), np.pad(data, ((1, 1), (0, 0)))
+        )
+        self.assert_array_equal(ht.repeat(x, 2, axis=0), np.repeat(data, 2, axis=0))
+        self.assert_array_equal(ht.tile(x, (2, 1)), np.tile(data, (2, 1)))
+
+    def test_split_funcs(self):
+        data = np.arange(24, dtype=np.float32).reshape(6, 4)
+        x = ht.array(data, split=0)
+        parts = ht.split(x, 3, axis=0)
+        nparts = np.split(data, 3, axis=0)
+        for p, np_ in zip(parts, nparts):
+            self.assert_array_equal(p, np_)
+
+    def test_broadcast_to(self):
+        data = np.arange(4, dtype=np.float32)
+        x = ht.array(data, split=0)
+        r = ht.broadcast_to(x, (3, 4))
+        self.assert_array_equal(r, np.broadcast_to(data, (3, 4)))
+
+
+class TestSignal(TestCase):
+    def test_convolve(self):
+        sig = np.random.default_rng(61).random(50).astype(np.float32)
+        ker = np.array([0.25, 0.5, 0.25], dtype=np.float32)
+        for mode in ("full", "same", "valid"):
+            r = ht.convolve(ht.array(sig, split=0), ht.array(ker), mode=mode)
+            self.assert_array_equal(r, np.convolve(sig, ker, mode=mode), rtol=1e-4)
+
+
+class TestRandom(TestCase):
+    def test_reproducible_any_split(self):
+        """The reference's core RNG invariant: same seed → same global numbers
+        for any process count / split (heat/core/tests/test_random.py)."""
+        ht.random.seed(123)
+        a = ht.random.rand(20, 10, split=0).numpy()
+        ht.random.seed(123)
+        b = ht.random.rand(20, 10, split=1).numpy()
+        ht.random.seed(123)
+        c = ht.random.rand(20, 10).numpy()
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+
+    def test_rand_range_and_moments(self):
+        ht.random.seed(0)
+        x = ht.random.rand(1000, split=0)
+        arr = x.numpy()
+        self.assertTrue((arr >= 0).all() and (arr < 1).all())
+        self.assertAlmostEqual(arr.mean(), 0.5, delta=0.05)
+
+    def test_randn_moments(self):
+        ht.random.seed(1)
+        x = ht.random.randn(2000, split=0).numpy()
+        self.assertAlmostEqual(x.mean(), 0.0, delta=0.1)
+        self.assertAlmostEqual(x.std(), 1.0, delta=0.1)
+
+    def test_randint(self):
+        ht.random.seed(2)
+        x = ht.random.randint(0, 10, size=(100,), split=0).numpy()
+        self.assertTrue((x >= 0).all() and (x < 10).all())
+
+    def test_randperm_permutation(self):
+        ht.random.seed(3)
+        p = ht.random.randperm(20).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(20))
+        x = ht.arange(10, split=0)
+        shuffled = ht.random.permutation(x).numpy()
+        np.testing.assert_array_equal(np.sort(shuffled), np.arange(10))
+
+    def test_state(self):
+        ht.random.seed(77)
+        state = ht.random.get_state()
+        a = ht.random.rand(10).numpy()
+        ht.random.set_state(state)
+        b = ht.random.rand(10).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestReviewRegressions(TestCase):
+    """Regression tests for the round-1 code-review findings."""
+
+    def test_bucketize_right_flag(self):
+        boundaries = np.array([1, 3, 5, 7, 9], dtype=np.float32)
+        v = np.array([[3, 6, 9], [3, 6, 9]], dtype=np.float32)
+        x = ht.array(v, split=0)
+        b = ht.array(boundaries)
+        self.assert_array_equal(
+            ht.bucketize(x, b, right=False), np.searchsorted(boundaries, v, side="left")
+        )
+        self.assert_array_equal(
+            ht.bucketize(x, b, right=True), np.searchsorted(boundaries, v, side="right")
+        )
+
+    def test_convolve_same_even_kernel(self):
+        sig = np.array([1, 2, 3], dtype=np.float32)
+        ker = np.array([1, 1], dtype=np.float32)
+        r = ht.convolve(ht.array(sig, split=0), ker, mode="same")
+        self.assert_array_equal(r, np.convolve(sig, ker, mode="same"))
+
+    def test_matmul_matrix_vector_split(self):
+        a = ht.ones((6, 4), split=0)
+        v = ht.ones((4,))
+        r = ht.matmul(a, v)
+        self.assertIn(r.split, (0, None))
+        self.assertNotEqual(r.split, -1)
+        self.assert_array_equal(r, np.full(6, 4.0, dtype=np.float32))
+
+    def test_vstack_1d_split(self):
+        a = ht.arange(8, dtype=ht.float32, split=0)
+        b = ht.arange(8, dtype=ht.float32, split=0)
+        r = ht.vstack([a, b])
+        self.assertEqual(r.split, 1)
+        self.assert_array_equal(r, np.vstack([np.arange(8), np.arange(8)]).astype(np.float32))
+
+    def test_out_split_metadata_consistent(self):
+        a = ht.random.rand(8, 4, split=0)
+        out = ht.zeros((4,), split=0)
+        _ = out.lshape_map  # populate cache
+        ht.sum(a, axis=0, out=out)
+        self.assertIsNone(out.split)
+        np.testing.assert_array_equal(out.lshape_map, out.comm.lshape_map((4,), None))
