@@ -1,0 +1,5 @@
+"""Classification estimators (reference: heat/classification/)."""
+
+from .kneighborsclassifier import KNeighborsClassifier
+
+__all__ = ["KNeighborsClassifier"]

@@ -1,0 +1,8 @@
+"""Clustering estimators (reference: heat/cluster/)."""
+
+from .kmeans import KMeans
+from .kmedians import KMedians
+from .kmedoids import KMedoids
+from .spectral import Spectral
+
+__all__ = ["KMeans", "KMedians", "KMedoids", "Spectral"]

@@ -1,0 +1,142 @@
+"""Shared k-clustering base (reference: heat/cluster/_kcluster.py, 254 LoC).
+
+Init strategies match the reference (:87-194): ``"random"`` stratified point
+sampling, ``"probability_based"`` (kmeans++) distance-weighted sampling, or
+directly passed centroids.  Where the reference walks displacement tables and
+Bcasts the chosen rows rank by rank, here a gather from the global array is
+one XLA op (the sampled rows end up replicated, exactly like the Bcast)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+
+from ..core import random as ht_random
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray, _ensure_split
+from ..core import types
+
+__all__ = ["_KCluster"]
+
+
+class _KCluster(ClusteringMixin, BaseEstimator):
+    """Base class for k-statistics clustering (KMeans/KMedians/KMedoids)."""
+
+    def __init__(
+        self,
+        metric: Callable,
+        n_clusters: int,
+        init: Union[str, DNDarray],
+        max_iter: int,
+        tol: float,
+        random_state: Optional[int],
+    ):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+
+        self._metric = metric
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        """Coordinates of the cluster centers (replicated)."""
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        return self._n_iter
+
+    def _initialize_cluster_centers(self, x: DNDarray):
+        """Pick initial centroids (reference: _kcluster.py:87)."""
+        if self.random_state is not None:
+            ht_random.seed(self.random_state)
+        k = self.n_clusters
+        n = x.shape[0]
+        arr = x.larray
+
+        if isinstance(self.init, DNDarray):
+            if self.init.ndim != 2:
+                raise ValueError("passed centroids need to be two-dimensional")
+            if self.init.shape[0] != k or self.init.shape[1] != x.shape[1]:
+                raise ValueError("passed centroids do not match cluster count or data shape")
+            self._cluster_centers = self.init.resplit(None)
+            return
+
+        if isinstance(self.init, str) and self.init == "random":
+            # one sample per stratum [i*n/k, (i+1)*n/k) — the reference's
+            # equal-distribution draw (_kcluster.py:101-123)
+            idx = []
+            for i in range(k):
+                lo = n // k * i
+                hi = n // k * (i + 1)
+                idx.append(int(ht_random.randint(lo, max(hi, lo + 1)).item()))
+            centroids = arr[jnp.asarray(idx)]
+        elif isinstance(self.init, str) and self.init in ("probability_based", "kmeans++"):
+            # kmeans++: iterative distance-weighted sampling (_kcluster.py:141)
+            first = int(ht_random.randint(0, n - 1).item())
+            chosen = [first]
+            centers = arr[jnp.asarray([first])]
+            for _ in range(1, k):
+                centers_ht = DNDarray(
+                    centers, tuple(centers.shape),
+                    types.canonical_heat_type(centers.dtype), None, x.device, x.comm,
+                )
+                d = self._metric(x, centers_ht).larray
+                d2 = jnp.min(d, axis=1)
+                prob = d2 / jnp.sum(d2)
+                u = float(ht_random.rand().item())
+                cum = jnp.cumsum(prob)
+                nxt = int(jnp.searchsorted(cum, u))
+                nxt = min(nxt, n - 1)
+                chosen.append(nxt)
+                centers = arr[jnp.asarray(chosen)]
+            centroids = centers
+        else:
+            raise ValueError(
+                f'init needs to be "random", "kmeans++"/"probability_based" or a '
+                f"DNDarray, but was {self.init!r}"
+            )
+
+        self._cluster_centers = DNDarray(
+            centroids, tuple(centroids.shape),
+            types.canonical_heat_type(centroids.dtype), None, x.device, x.comm,
+        )
+
+    def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
+        """Assign each sample to its closest centroid (reference:
+        _kcluster.py:196)."""
+        distances = self._metric(x, self._cluster_centers)
+        labels = jnp.argmin(distances.larray, axis=1, keepdims=True)
+        out = DNDarray(
+            labels, tuple(labels.shape), types.canonical_heat_type(labels.dtype),
+            x.split, x.device, x.comm,
+        )
+        return _ensure_split(out, x.split)
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
+        raise NotImplementedError()
+
+    def fit(self, x: DNDarray):
+        raise NotImplementedError()
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Closest-cluster index for each sample (reference: _kcluster.py)."""
+        from ..core import sanitation
+
+        sanitation.sanitize_in(x)
+        return self._assign_to_cluster(x)

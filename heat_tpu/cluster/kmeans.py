@@ -1,0 +1,117 @@
+"""K-Means clustering (reference: heat/cluster/kmeans.py, 139 LoC).
+
+The reference's Lloyd iteration issues one Allreduce per cluster per step for
+the masked sums (kmeans.py:73-100).  Here the whole iteration — distance
+matrix (quadratic expansion on the MXU), argmin, one-hot count/sum matmuls —
+is a single jitted XLA program with one fused cross-device reduction
+(SURVEY.md §3.4), the benchmark north-star workload.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+from ..core import types
+from ..spatial import distance
+from ._kcluster import _KCluster
+
+__all__ = ["KMeans"]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(x, centers, k: int):
+    """One fused Lloyd iteration: returns (new_centers, shift², inertia).
+
+    With ``x`` row-sharded and ``centers`` replicated, XLA compiles this to
+    local MXU matmuls plus a single psum of the (k, f) sums and (k,) counts.
+    """
+    x2 = jnp.sum(x * x, axis=1)[:, None]
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]
+    cross = jnp.matmul(x, centers.T)
+    d2 = x2 + c2 - 2.0 * cross
+    labels = jnp.argmin(d2, axis=1)
+    onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(x.dtype)
+    counts = jnp.sum(onehot, axis=0)
+    sums = jnp.matmul(onehot.T, x)
+    new_centers = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1)[:, None], centers)
+    shift = jnp.sum((new_centers - centers) ** 2)
+    inertia = jnp.sum(jnp.take_along_axis(d2, labels[:, None], axis=1))
+    return new_centers, shift, inertia
+
+
+class KMeans(_KCluster):
+    """K-Means with Lloyd's algorithm (reference: kmeans.py:13).
+
+    Parameters mirror the reference: ``n_clusters``, ``init`` ("random",
+    "kmeans++"/"probability_based", or explicit centroids), ``max_iter``,
+    ``tol`` (convergence on squared centroid shift), ``random_state``.
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        if isinstance(init, str) and init == "kmeans++":
+            init = "probability_based"
+        super().__init__(
+            metric=lambda x, y: distance.cdist(x, y, quadratic_expansion=True),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=tol,
+            random_state=random_state,
+        )
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
+        """Masked-mean centroid update (reference: kmeans.py:73). Exposed for
+        API parity; ``fit`` uses the fused step."""
+        labels = matching_centroids.larray.reshape(-1)
+        arr = x.larray
+        onehot = (labels[:, None] == jnp.arange(self.n_clusters)[None, :]).astype(arr.dtype)
+        counts = jnp.sum(onehot, axis=0)
+        sums = jnp.matmul(onehot.T, arr)
+        old = self._cluster_centers.larray
+        new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1)[:, None], old)
+        return DNDarray(
+            new, tuple(new.shape), types.canonical_heat_type(new.dtype),
+            None, x.device, x.comm,
+        )
+
+    def fit(self, x: DNDarray) -> "KMeans":
+        """Lloyd iterations until centroid shift < tol (reference:
+        kmeans.py:102-139)."""
+        from ..core import sanitation
+
+        sanitation.sanitize_in(x)
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2-D, but was {x.ndim}-D")
+        self._initialize_cluster_centers(x)
+
+        arr = x.larray
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(jnp.float32)
+        centers = self._cluster_centers.larray.astype(arr.dtype)
+
+        self._n_iter = 0
+        for _ in range(self.max_iter):
+            centers, shift, inertia = _lloyd_step(arr, centers, self.n_clusters)
+            self._n_iter += 1
+            if float(shift) <= self.tol:
+                break
+
+        self._cluster_centers = DNDarray(
+            centers, tuple(centers.shape), types.canonical_heat_type(centers.dtype),
+            None, x.device, x.comm,
+        )
+        self._labels = self._assign_to_cluster(x)
+        self._inertia = float(inertia)
+        return self

@@ -1,0 +1,85 @@
+"""K-Medoids clustering (reference: heat/cluster/kmedoids.py, 150 LoC).
+
+Reference semantics (kmedoids.py:56): the new center of cluster i is the data
+point closest to the median of the points assigned to i; iteration stops when
+the centers stop moving (tol = 0)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+from ..core import types
+from ..spatial import distance
+from ._kcluster import _KCluster
+
+__all__ = ["KMedoids"]
+
+
+class KMedoids(_KCluster):
+    """K-Medoids: centers snap to actual data points (reference: kmedoids.py:10)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        random_state: Optional[int] = None,
+    ):
+        if isinstance(init, str) and init == "kmedoids++":
+            init = "probability_based"
+        super().__init__(
+            metric=lambda x, y: distance.cdist(x, y),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=0.0,
+            random_state=random_state,
+        )
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
+        """Median per cluster, then snap to the nearest sample (reference:
+        kmedoids.py:56-110)."""
+        labels = matching_centroids.larray.reshape(-1)
+        arr = x.larray
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(jnp.float32)
+        old = self._cluster_centers.larray.astype(arr.dtype)
+        mask = labels[:, None] == jnp.arange(self.n_clusters)[None, :]
+        masked = jnp.where(mask[:, :, None], arr[:, None, :], jnp.nan)
+        med = jnp.nanmedian(masked, axis=0)  # (k, f)
+        counts = jnp.sum(mask, axis=0)
+        med = jnp.where(counts[:, None] > 0, med, old)
+        # snap each median to the closest actual data point (the medoid)
+        x2 = jnp.sum(arr * arr, axis=1)[:, None]
+        m2 = jnp.sum(med * med, axis=1)[None, :]
+        d2 = x2 + m2 - 2.0 * jnp.matmul(arr, med.T)  # (n, k)
+        idx = jnp.argmin(d2, axis=0)  # (k,)
+        new = arr[idx]
+        new = jnp.where(counts[:, None] > 0, new, old)
+        return DNDarray(
+            new, tuple(new.shape), types.canonical_heat_type(new.dtype),
+            None, x.device, x.comm,
+        )
+
+    def fit(self, x: DNDarray) -> "KMedoids":
+        """Iterate until the medoids stop changing (reference: kmedoids.py fit)."""
+        from ..core import sanitation
+
+        sanitation.sanitize_in(x)
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2-D, but was {x.ndim}-D")
+        self._initialize_cluster_centers(x)
+        self._n_iter = 0
+        for _ in range(self.max_iter):
+            labels = self._assign_to_cluster(x)
+            new_centers = self._update_centroids(x, labels)
+            unchanged = bool(jnp.all(new_centers.larray == self._cluster_centers.larray))
+            self._cluster_centers = new_centers
+            self._n_iter += 1
+            if unchanged:
+                break
+        self._labels = self._assign_to_cluster(x)
+        return self

@@ -1,0 +1,5 @@
+"""Graph computations (reference: heat/graph/)."""
+
+from .laplacian import Laplacian
+
+__all__ = ["Laplacian"]

@@ -1,0 +1,5 @@
+"""Utilities (reference: heat/utils/)."""
+
+from . import data
+
+__all__ = ["data"]

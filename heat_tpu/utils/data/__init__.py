@@ -1,0 +1,8 @@
+"""Data utilities (reference: heat/utils/data/)."""
+
+from . import matrixgallery
+from . import spherical
+from .spherical import create_spherical_dataset
+from .matrixgallery import parter
+
+__all__ = ["matrixgallery", "spherical", "create_spherical_dataset", "parter"]

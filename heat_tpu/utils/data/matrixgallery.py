@@ -1,0 +1,28 @@
+"""Test-matrix generators (reference: heat/utils/data/matrixgallery.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Type, Union
+
+import jax.numpy as jnp
+
+from ...core import factories, types
+from ...core.dndarray import DNDarray
+
+__all__ = ["parter"]
+
+
+def parter(
+    n: int,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+    dtype: Type[types.datatype] = types.float32,
+) -> DNDarray:
+    """The Parter matrix A[i,j] = 1/(i − j + 0.5), a Toeplitz matrix whose
+    singular values cluster at π (reference: matrixgallery.py:15)."""
+    a = factories.arange(n, dtype=dtype, device=device, comm=comm)
+    II = a.larray[None, :]
+    JJ = a.larray[:, None]
+    arr = 1.0 / (II - JJ + 0.5)
+    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)

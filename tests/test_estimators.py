@@ -1,0 +1,229 @@
+"""ML estimator layer tests (reference models: heat/cluster/tests/,
+heat/regression/tests/, heat/classification/tests/, heat/naive_bayes/tests/,
+heat/spatial/tests/, heat/graph/tests/)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+def spherical_data(n_per_cluster=64, seed=5):
+    return ht.utils.data.create_spherical_dataset(n_per_cluster, random_state=seed)
+
+
+class TestCdist(TestCase):
+    def test_cdist_split_matrix(self):
+        rng = np.random.default_rng(201)
+        a = rng.random((17, 5)).astype(np.float32)
+        b = rng.random((9, 5)).astype(np.float32)
+        from scipy.spatial.distance import cdist as scipy_cdist
+
+        expected = scipy_cdist(a, b).astype(np.float32)
+        for sa in (None, 0):
+            for sb in (None, 0):
+                r = ht.spatial.cdist(ht.array(a, split=sa), ht.array(b, split=sb))
+                self.assert_array_equal(r, expected, rtol=1e-3, atol=1e-4)
+        # self-distance: zero diagonal
+        d = ht.spatial.cdist(ht.array(a, split=0))
+        np.testing.assert_allclose(np.diag(d.numpy()), 0.0, atol=1e-3)
+
+    def test_manhattan_rbf(self):
+        rng = np.random.default_rng(203)
+        a = rng.random((11, 4)).astype(np.float32)
+        b = rng.random((7, 4)).astype(np.float32)
+        from scipy.spatial.distance import cdist as scipy_cdist
+
+        man = ht.spatial.manhattan(ht.array(a, split=0), ht.array(b))
+        self.assert_array_equal(man, scipy_cdist(a, b, metric="cityblock"), rtol=1e-4, atol=1e-5)
+        sigma = 2.0
+        rbf = ht.spatial.rbf(ht.array(a, split=0), ht.array(b), sigma=sigma)
+        expected = np.exp(-scipy_cdist(a, b) ** 2 / (2 * sigma**2))
+        self.assert_array_equal(rbf, expected, rtol=1e-4, atol=1e-5)
+
+
+class TestKClustering(TestCase):
+    def test_kmeans_spherical(self):
+        data = spherical_data(64)
+        for init in ("random", "kmeans++"):
+            km = ht.cluster.KMeans(n_clusters=4, init=init, max_iter=50, random_state=3)
+            km.fit(data)
+            self.assertEqual(km.cluster_centers_.shape, (4, 3))
+            labels = km.labels_.numpy().reshape(-1)
+            self.assertEqual(labels.shape[0], data.shape[0])
+            # the 4 well-separated clusters must be recovered: each ground-truth
+            # block maps to a single dominant label
+            n = data.shape[0] // 4
+            found = set()
+            for c in range(4):
+                block = labels[c * n : (c + 1) * n]
+                dominant = np.bincount(block).argmax()
+                frac = (block == dominant).mean()
+                self.assertGreater(frac, 0.95)
+                found.add(dominant)
+            self.assertEqual(len(found), 4)
+
+    def test_kmeans_predict_inertia(self):
+        data = spherical_data(32)
+        km = ht.cluster.KMeans(n_clusters=4, random_state=1).fit(data)
+        pred = km.predict(data)
+        self.assertEqual(pred.shape[0], data.shape[0])
+        self.assertIsInstance(km.inertia_, float)
+        self.assertGreaterEqual(km.n_iter_, 1)
+
+    def test_kmeans_explicit_init(self):
+        data = spherical_data(32)
+        centers = ht.array(np.asarray(data.larray)[[0, 40, 80, 120]])
+        km = ht.cluster.KMeans(n_clusters=4, init=centers, max_iter=20).fit(data)
+        self.assertEqual(km.cluster_centers_.shape, (4, 3))
+
+    def test_kmedians_kmedoids(self):
+        data = spherical_data(32)
+        kmed = ht.cluster.KMedians(n_clusters=4, random_state=7, max_iter=30).fit(data)
+        self.assertEqual(kmed.cluster_centers_.shape, (4, 3))
+        kmdd = ht.cluster.KMedoids(n_clusters=4, random_state=9, max_iter=30).fit(data)
+        # medoids are actual data points
+        centers = kmdd.cluster_centers_.numpy()
+        X = data.numpy()
+        for c in centers:
+            self.assertTrue(np.any(np.all(np.isclose(X, c, atol=1e-5), axis=1)))
+
+    def test_spectral(self):
+        data = spherical_data(16, seed=11)
+        sp = ht.cluster.Spectral(n_clusters=4, gamma=0.1, n_lanczos=30)
+        sp.fit(data)
+        labels = sp.labels_.numpy().reshape(-1)
+        self.assertEqual(labels.shape[0], data.shape[0])
+        self.assertLessEqual(len(np.unique(labels)), 4)
+
+
+class TestLasso(TestCase):
+    def test_lasso_recovers_sparse_signal(self):
+        rng = np.random.default_rng(301)
+        n, f = 200, 16
+        X = rng.standard_normal((n, f)).astype(np.float32)
+        # the coordinate-descent update (like the reference's, lasso.py:90-107)
+        # assumes unit-norm features: normalize columns to x_j·x_j/m = 1
+        X = X / np.sqrt((X**2).mean(axis=0, keepdims=True))
+        beta = np.zeros(f, dtype=np.float32)
+        beta[[1, 5, 9]] = [2.0, -3.0, 1.5]
+        yv = X @ beta + 0.01 * rng.standard_normal(n).astype(np.float32)
+        lasso = ht.regression.Lasso(lam=0.01, max_iter=200)
+        lasso.fit(ht.array(X, split=0), ht.array(yv.reshape(-1, 1), split=0))
+        coef = lasso.coef_.numpy().reshape(-1)
+        np.testing.assert_allclose(coef, beta, atol=0.1)
+        # sparsity: zero coefficients stay (near) zero
+        mask = np.ones(f, bool)
+        mask[[1, 5, 9]] = False
+        self.assertLess(np.abs(coef[mask]).max(), 0.05)
+        pred = lasso.predict(ht.array(X, split=0))
+        self.assertLess(lasso.rmse(ht.array(yv.reshape(-1, 1)), pred), 0.2)
+        r2 = lasso.score(ht.array(X, split=0), ht.array(yv.reshape(-1, 1)))
+        self.assertGreater(r2, 0.95)
+
+
+class TestKNN(TestCase):
+    def test_knn_separable(self):
+        rng = np.random.default_rng(401)
+        a = rng.standard_normal((60, 2)).astype(np.float32) + np.array([5, 5], np.float32)
+        b = rng.standard_normal((60, 2)).astype(np.float32) - np.array([5, 5], np.float32)
+        X = np.vstack([a, b])
+        y = np.array([0] * 60 + [1] * 60)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        knn.fit(ht.array(X, split=0), ht.array(y, split=0))
+        pred = knn.predict(ht.array(X, split=0)).numpy()
+        np.testing.assert_array_equal(pred, y)
+        self.assertEqual(knn.score(ht.array(X, split=0), ht.array(y, split=0)), 1.0)
+
+
+class TestGaussianNB(TestCase):
+    def _make_data(self, seed=501):
+        rng = np.random.default_rng(seed)
+        a = rng.standard_normal((80, 3)).astype(np.float32) + np.array([4, 0, 0], np.float32)
+        b = rng.standard_normal((80, 3)).astype(np.float32) + np.array([-4, 2, 0], np.float32)
+        c = rng.standard_normal((80, 3)).astype(np.float32) + np.array([0, -4, 3], np.float32)
+        X = np.vstack([a, b, c])
+        y = np.array([0] * 80 + [1] * 80 + [2] * 80)
+        return X, y
+
+    def test_fit_predict(self):
+        X, y = self._make_data()
+        gnb = ht.naive_bayes.GaussianNB()
+        gnb.fit(ht.array(X, split=0), ht.array(y, split=0))
+        pred = gnb.predict(ht.array(X, split=0)).numpy()
+        self.assertGreater((pred == y).mean(), 0.97)
+        proba = gnb.predict_proba(ht.array(X[:5], split=0)).numpy()
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+        # moments match sklearn-style per-class stats
+        for ci in range(3):
+            np.testing.assert_allclose(
+                gnb.theta_.numpy()[ci], X[y == ci].mean(axis=0), rtol=1e-3, atol=1e-3
+            )
+
+    def test_partial_fit_matches_full_fit(self):
+        X, y = self._make_data(seed=503)
+        full = ht.naive_bayes.GaussianNB().fit(ht.array(X, split=0), ht.array(y, split=0))
+        inc = ht.naive_bayes.GaussianNB()
+        classes = ht.array(np.array([0, 1, 2]))
+        inc.partial_fit(ht.array(X[:100], split=0), ht.array(y[:100], split=0), classes=classes)
+        inc.partial_fit(ht.array(X[100:], split=0), ht.array(y[100:], split=0))
+        np.testing.assert_allclose(inc.theta_.numpy(), full.theta_.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(inc.var_.numpy(), full.var_.numpy(), rtol=1e-3, atol=1e-4)
+
+
+class TestLaplacian(TestCase):
+    def test_norm_sym_laplacian(self):
+        rng = np.random.default_rng(601)
+        X = rng.random((12, 3)).astype(np.float64)
+        lap = ht.graph.Laplacian(
+            lambda x: ht.spatial.rbf(x, sigma=1.0), definition="norm_sym"
+        )
+        L = lap.construct(ht.array(X, split=0)).numpy()
+        # symmetric, unit diagonal, eigenvalues in [0, 2]
+        np.testing.assert_allclose(L, L.T, atol=1e-10)
+        np.testing.assert_allclose(np.diag(L), 1.0, atol=1e-10)
+        ev = np.linalg.eigvalsh(L)
+        self.assertGreaterEqual(ev.min(), -1e-8)
+        self.assertLessEqual(ev.max(), 2.0 + 1e-8)
+
+    def test_simple_laplacian_rowsums(self):
+        rng = np.random.default_rng(603)
+        X = rng.random((10, 3)).astype(np.float64)
+        lap = ht.graph.Laplacian(
+            lambda x: ht.spatial.rbf(x, sigma=1.0), definition="simple"
+        )
+        L = lap.construct(ht.array(X, split=0)).numpy()
+        np.testing.assert_allclose(L.sum(axis=1), 0.0, atol=1e-8)
+
+
+class TestEstimatorReviewRegressions(TestCase):
+    """Regressions for the round-1 estimator-layer review findings."""
+
+    def test_gnb_variance_large_offset_float32(self):
+        rng = np.random.default_rng(701)
+        a = rng.standard_normal((100, 2)).astype(np.float32) + 10000.0
+        b = rng.standard_normal((100, 2)).astype(np.float32) + 10003.0
+        X = np.vstack([a, b])
+        y = np.array([0] * 100 + [1] * 100)
+        gnb = ht.naive_bayes.GaussianNB().fit(ht.array(X, split=0), ht.array(y, split=0))
+        np.testing.assert_allclose(
+            gnb.var_.numpy()[0], X[:100].var(axis=0), rtol=0.01
+        )
+        self.assertGreater(gnb.score(ht.array(X, split=0), ht.array(y, split=0)), 0.85)
+
+    def test_spectral_out_of_sample_shape(self):
+        data = spherical_data(16, seed=13)
+        sp = ht.cluster.Spectral(n_clusters=4, gamma=0.1, n_lanczos=20).fit(data)
+        new = ht.array(data.numpy()[:10], split=0)
+        pred = sp.predict(new)
+        self.assertEqual(pred.shape[0], 10)
+
+    def test_knn_sample_mismatch_raises(self):
+        X = ht.ones((10, 3), split=0)
+        y = ht.zeros((5,), split=0)
+        with self.assertRaises(ValueError):
+            ht.classification.KNeighborsClassifier().fit(X, y)
+
+    def test_laplacian_bad_threshold_key(self):
+        with self.assertRaises(ValueError):
+            ht.graph.Laplacian(lambda x: x, threshold_key="Upper")
