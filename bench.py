@@ -1,0 +1,86 @@
+"""Benchmark harness: distributed matmul TFLOP/s per chip.
+
+The first north-star metric from BASELINE.md ("distributed matmul
+TFLOP/s/chip ... ≥40% MFU"). Runs ht.matmul on bfloat16 split DNDarrays —
+the framework's own GSPMD matmul path — and reports achieved TFLOP/s per
+chip. ``vs_baseline`` is the achieved fraction of the 40%-MFU target
+(value / (0.40 * peak)); > 1.0 beats the target.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_tflops_bf16(device) -> float:
+    """Per-chip bf16 peak by device kind (public spec sheets)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v5 lite": 197.0,  # TPU v5e: 197 TFLOP/s bf16
+        "v5e": 197.0,
+        "v5p": 459.0,
+        "v5": 459.0,
+        "v4": 275.0,
+        "v6": 918.0,
+        "v6e": 918.0,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197.0  # conservative default
+
+
+def main() -> None:
+    import jax
+
+    import heat_tpu as ht
+
+    n_chips = len(jax.devices())
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    # size the problem to the platform: big enough to saturate the MXU on
+    # TPU, small enough to finish quickly on the CPU fallback
+    n = 8192 if on_tpu else 512
+    a = ht.random.randn(n, n, dtype=ht.bfloat16, split=0)
+    b = ht.random.randn(n, n, dtype=ht.bfloat16, split=None)
+
+    def chain(k: int) -> float:
+        """k chained ht.matmuls; the scalar readback at the end drains the
+        device queue (block_until_ready does not synchronize through remote
+        TPU tunnels, so timing uses the slope between two chain lengths to
+        cancel the fixed round-trip latency)."""
+        c = a
+        t0 = time.perf_counter()
+        for _ in range(k):
+            c = ht.matmul(c, b)
+        float(ht.sum(c.astype(ht.float32) * 0.0))
+        return time.perf_counter() - t0
+
+    chain(2)  # warmup + compile
+    k1, k2 = (4, 24) if on_tpu else (1, 3)
+    best = float("inf")
+    for _ in range(3):
+        t1, t2 = chain(k1), chain(k2)
+        best = min(best, (t2 - t1) / (k2 - k1))
+
+    flops = 2.0 * n * n * n
+    tflops_per_chip = flops / best / n_chips / 1e12
+    peak = peak_tflops_bf16(dev) if on_tpu else 1.0
+    target = 0.40 * peak
+    result = {
+        "metric": "distributed_matmul_tflops_per_chip",
+        "value": round(tflops_per_chip, 2),
+        "unit": "TFLOP/s/chip (bf16, n=%d, %d chip(s), %s)" % (n, n_chips, dev.device_kind),
+        "vs_baseline": round(tflops_per_chip / target, 3) if on_tpu else round(tflops_per_chip, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

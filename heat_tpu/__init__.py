@@ -47,6 +47,18 @@ from . import naive_bayes
 from . import regression
 from . import spatial
 from . import sparse
-from . import nn
-from . import optim
 from . import utils
+
+# nn / optim / models pull in flax and optax (the optional "nn" extra);
+# load them lazily so a base install can import the array library
+_LAZY_SUBPACKAGES = ("nn", "optim", "models")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBPACKAGES:
+        import importlib
+
+        module = importlib.import_module(f".{name}", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")

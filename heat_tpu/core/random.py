@@ -167,10 +167,13 @@ def randint(low, high=None, size=None, dtype=types.int32, split=None, device=Non
 random_integer = randint
 
 
-def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
-    """Random permutation of arange(n) (reference: random.py:649)."""
+def randperm(n: int, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of arange(n) (reference: random.py:649 defaults to
+    int64; here the default follows the x64 mode so TPU runs stay int32)."""
     key = __next_key()
     comm_ = sanitize_comm(comm)
+    if dtype is None:
+        dtype = types.int64 if jax.config.jax_enable_x64 else types.int32
     perm = jax.random.permutation(key, int(n)).astype(types.canonical_heat_type(dtype).jax_type())
     return _finalize(perm, split, device, comm_)
 

@@ -1,0 +1,6 @@
+"""Model zoo (TPU-native; the reference trains external torch models)."""
+
+from .mlp import MLP
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+
+__all__ = ["MLP", "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152"]

@@ -1,0 +1,24 @@
+"""Simple MLP (the reference's MNIST example net, examples/nn/mnist.py,
+expressed in linen)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+__all__ = ["MLP"]
+
+
+class MLP(nn.Module):
+    """Fully-connected classifier: features[:-1] hidden layers + output."""
+
+    features: Sequence[int] = (128, 64, 10)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for feat in self.features[:-1]:
+            x = nn.relu(nn.Dense(feat)(x))
+        return nn.Dense(self.features[-1])(x)

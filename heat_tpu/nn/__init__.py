@@ -1,0 +1,23 @@
+"""Neural network layer (reference: heat/nn/).
+
+The reference exposes ``ht.nn.X`` by falling through to ``torch.nn.X`` via a
+module ``__getattr__`` (heat/nn/__init__.py:19-31). The TPU-native substrate
+is Flax linen, so ``ht.nn.Conv``, ``ht.nn.Dense``, ``ht.nn.Module`` etc. fall
+through to ``flax.linen`` the same way; ``ht.nn.functional`` falls through to
+``jax.nn``.
+"""
+
+import flax.linen as _linen
+import jax.nn as functional  # reference: heat/nn/functional.py falls through
+
+from .data_parallel import DataParallel, DataParallelMultiGPU
+
+__all__ = ["DataParallel", "DataParallelMultiGPU", "functional"]
+
+
+def __getattr__(name):
+    """Fall through to flax.linen (reference: nn/__init__.py:19-31)."""
+    try:
+        return getattr(_linen, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn' has no attribute {name!r}")

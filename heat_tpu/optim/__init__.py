@@ -1,0 +1,33 @@
+"""Optimizers (reference: heat/optim/).
+
+``ht.optim.X`` falls through to optax (the reference falls through to
+torch.optim the same way)."""
+
+import optax as _optax
+
+from . import lr_scheduler, utils
+from .dp_optimizer import DASO, DataParallelOptimizer
+from .utils import DetectMetricPlateau
+
+__all__ = ["DASO", "DataParallelOptimizer", "DetectMetricPlateau", "lr_scheduler", "utils"]
+
+_TORCH_TO_OPTAX = {
+    "SGD": "sgd",
+    "Adam": "adam",
+    "AdamW": "adamw",
+    "Adagrad": "adagrad",
+    "RMSprop": "rmsprop",
+    "Adadelta": "adadelta",
+    "LAMB": "lamb",
+    "LARS": "lars",
+}
+
+
+def __getattr__(name):
+    """Fall through to optax, accepting the torch-style capitalized names the
+    reference exposes (ht.optim.SGD → optax.sgd)."""
+    target = _TORCH_TO_OPTAX.get(name, name)
+    try:
+        return getattr(_optax, target)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.optim' has no attribute {name!r}")

@@ -1,0 +1,43 @@
+"""Learning-rate schedules (reference: heat/optim/lr_scheduler.py re-exports
+the torch schedulers). Here the native schedules are optax's; torch-style
+names are aliased for familiarity."""
+
+from __future__ import annotations
+
+import optax
+
+__all__ = [
+    "constant_schedule",
+    "cosine_decay_schedule",
+    "exponential_decay",
+    "linear_schedule",
+    "piecewise_constant_schedule",
+    "warmup_cosine_decay_schedule",
+    "StepLR",
+    "ExponentialLR",
+    "CosineAnnealingLR",
+]
+
+constant_schedule = optax.constant_schedule
+cosine_decay_schedule = optax.cosine_decay_schedule
+exponential_decay = optax.exponential_decay
+linear_schedule = optax.linear_schedule
+piecewise_constant_schedule = optax.piecewise_constant_schedule
+warmup_cosine_decay_schedule = optax.warmup_cosine_decay_schedule
+
+
+def StepLR(base_lr: float, step_size: int, gamma: float = 0.1):
+    """torch.optim.lr_scheduler.StepLR equivalent as an optax schedule."""
+    return optax.exponential_decay(
+        init_value=base_lr, transition_steps=step_size, decay_rate=gamma, staircase=True
+    )
+
+
+def ExponentialLR(base_lr: float, gamma: float):
+    """Per-step exponential decay."""
+    return optax.exponential_decay(init_value=base_lr, transition_steps=1, decay_rate=gamma)
+
+
+def CosineAnnealingLR(base_lr: float, T_max: int, eta_min: float = 0.0):
+    """Cosine annealing to ``eta_min`` over ``T_max`` steps."""
+    return optax.cosine_decay_schedule(init_value=base_lr, decay_steps=T_max, alpha=eta_min / max(base_lr, 1e-30))

@@ -1,0 +1,9 @@
+"""Distributed sparse matrices (reference: heat/sparse/)."""
+
+from . import arithmetics, manipulations
+from .arithmetics import add, mul
+from .dcsr_matrix import DCSR_matrix
+from .factories import sparse_csr_matrix
+from .manipulations import to_dense, todense
+
+__all__ = ["DCSR_matrix", "add", "mul", "sparse_csr_matrix", "to_dense", "todense"]

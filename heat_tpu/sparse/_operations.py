@@ -1,0 +1,31 @@
+"""Sparse op machinery (reference: heat/sparse/_operations.py:17).
+
+Sparse structure math (union of patterns for add, intersection for mul) is
+index bookkeeping, not FLOPs — scipy on host computes the result pattern and
+the payload lands back on device. Dense-side work stays on the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..core import types
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = []
+
+
+def _binary_op_csr(operation: Callable, t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
+    """Elementwise CSR-CSR operation (reference: _operations.py:17)."""
+    if not isinstance(t1, DCSR_matrix) or not isinstance(t2, DCSR_matrix):
+        raise TypeError(f"inputs must be DCSR_matrix, got {type(t1)}, {type(t2)}")
+    if t1.shape != t2.shape:
+        raise ValueError(f"shapes do not match: {t1.shape} vs {t2.shape}")
+    a = t1.to_scipy()
+    b = t2.to_scipy()
+    result = operation(a, b).tocsr()
+    result.eliminate_zeros()
+    from .factories import sparse_csr_matrix
+
+    out_split = t1.split if t1.split is not None else t2.split
+    return sparse_csr_matrix(result, split=out_split, device=t1.device, comm=t1.comm)

@@ -1,0 +1,23 @@
+"""Sparse elementwise arithmetic (reference: heat/sparse/arithmetics.py via
+__binary_op_csr, heat/sparse/_operations.py:17)."""
+
+from __future__ import annotations
+
+from .dcsr_matrix import DCSR_matrix
+from ._operations import _binary_op_csr
+
+__all__ = ["add", "mul"]
+
+
+def add(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
+    """Elementwise sparse addition (reference: arithmetics.py:16)."""
+    import operator
+
+    return _binary_op_csr(operator.add, t1, t2)
+
+
+def mul(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
+    """Elementwise sparse multiplication (reference: arithmetics.py:54).
+    scipy's ``*`` is matmul for sparse matrices; ``.multiply`` is the
+    elementwise (Hadamard) product."""
+    return _binary_op_csr(lambda a, b: a.multiply(b), t1, t2)

@@ -1,0 +1,58 @@
+"""Sparse factories (reference: heat/sparse/factories.py:23)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core import devices as ht_devices
+from ..core import types
+from ..parallel.mesh import sanitize_comm
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["sparse_csr_matrix"]
+
+
+def sparse_csr_matrix(
+    obj,
+    dtype: Optional[types.datatype] = None,
+    copy: bool = True,
+    is_split: Optional[int] = None,
+    device=None,
+    comm=None,
+    split: Optional[int] = None,
+) -> DCSR_matrix:
+    """Build a DCSR_matrix from scipy CSR / dense array-likes (reference:
+    factories.py:23; torch or scipy input, split=0 row chunks)."""
+    comm = sanitize_comm(comm)
+    device = ht_devices.sanitize_device(device)
+
+    import scipy.sparse
+
+    if isinstance(obj, DCSR_matrix):
+        sp = obj.to_scipy()
+    elif scipy.sparse.issparse(obj):
+        sp = obj.tocsr()
+    else:
+        sp = scipy.sparse.csr_matrix(np.asarray(obj))
+
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        sp = sp.astype(np.dtype(types._np_equivalent(dtype)))
+
+    if split not in (None, 0) or is_split not in (None, 0):
+        raise ValueError("sparse matrices support split=0 (row chunks) only")
+    final_split = 0 if (split == 0 or is_split == 0) else None
+
+    arr = jsparse.BCSR(
+        (jnp.asarray(sp.data), jnp.asarray(sp.indices), jnp.asarray(sp.indptr)),
+        shape=sp.shape,
+    )
+    heat_type = types.canonical_heat_type(sp.data.dtype) if dtype is None else dtype
+    return DCSR_matrix(
+        arr, int(sp.nnz), tuple(sp.shape), heat_type, final_split, device, comm
+    )

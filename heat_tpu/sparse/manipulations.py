@@ -1,0 +1,33 @@
+"""Sparse manipulations (reference: heat/sparse/manipulations.py:15)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core import factories, types
+from ..core.dndarray import DNDarray
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["todense", "to_dense"]
+
+
+def todense(sparse_matrix: DCSR_matrix, order: str = "C", out: Optional[DNDarray] = None) -> DNDarray:
+    """Densify into a row-split DNDarray (reference: manipulations.py:15)."""
+    dense = sparse_matrix.larray.todense()
+    result = factories.array(
+        dense,
+        dtype=sparse_matrix.dtype,
+        split=sparse_matrix.split,
+        device=sparse_matrix.device,
+        comm=sparse_matrix.comm,
+    )
+    if out is not None:
+        from ..core import sanitation
+
+        sanitation.sanitize_out(out, result.shape, result.split, result.device)
+        out.larray = result.parray.astype(out.dtype.jax_type())
+        return out
+    return result
+
+
+to_dense = todense

@@ -1,8 +1,20 @@
 """Data utilities (reference: heat/utils/data/)."""
 
-from . import matrixgallery
-from . import spherical
-from .spherical import create_spherical_dataset
+from . import matrixgallery, spherical
+from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
 from .matrixgallery import parter
+from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
+from .spherical import create_spherical_dataset
 
-__all__ = ["matrixgallery", "spherical", "create_spherical_dataset", "parter"]
+__all__ = [
+    "DataLoader",
+    "Dataset",
+    "PartialH5Dataset",
+    "PartialH5DataLoaderIter",
+    "create_spherical_dataset",
+    "dataset_ishuffle",
+    "dataset_shuffle",
+    "matrixgallery",
+    "parter",
+    "spherical",
+]

@@ -1,0 +1,115 @@
+"""Dataset / DataLoader over DNDarrays (reference:
+heat/utils/data/datatools.py, 376 LoC).
+
+The reference wraps each rank's *local shard* as a torch dataset and performs
+an **epoch-end global shuffle** by Alltoall-ing permuted samples between ranks
+(``dataset_shuffle``/``dataset_ishuffle``, datatools.py:246, :301).  Here the
+global array is shuffled with one sharded ``jax.random.permutation`` — the
+same all-to-all, emitted by XLA — and batches are sliced off the sharded
+array, so a batch is already distributed over the mesh when the train step
+consumes it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as ht_random
+from ...core import types
+from ...core.dndarray import DNDarray, _ensure_split
+
+__all__ = ["Dataset", "DataLoader", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """Dataset over one or more DNDarrays sharing the sample axis
+    (reference: datatools.py:143).
+
+    The reference's notion of "local shard as torch dataset" does not apply
+    under the single-controller model; indexing is global."""
+
+    def __init__(self, array: DNDarray, *arrays: DNDarray, transform=None):
+        self.arrays = (array,) + arrays
+        n = array.shape[0]
+        for a in self.arrays[1:]:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the sample dimension")
+        self.transform = transform
+
+    def __len__(self) -> int:
+        return self.arrays[0].shape[0]
+
+    def __getitem__(self, index):
+        items = tuple(a.larray[index] for a in self.arrays)
+        if self.transform is not None:
+            items = self.transform(*items)
+        return items[0] if len(items) == 1 else items
+
+    def shuffle(self) -> None:
+        """Globally shuffle all arrays with one shared permutation
+        (reference: dataset_shuffle, datatools.py:246)."""
+        n = len(self)
+        perm = ht_random.randperm(n).larray
+        new = []
+        for a in self.arrays:
+            shuffled = a.larray[perm]
+            wrapped = DNDarray(
+                shuffled, a.shape, a.dtype, a.split, a.device, a.comm
+            )
+            new.append(_ensure_split(wrapped, a.split))
+        self.arrays = tuple(new)
+
+
+class DataLoader:
+    """Iterates sharded batches of a Dataset/DNDarray (reference:
+    datatools.py:16).
+
+    Batches come off the sharded global array, so each device reads only its
+    own rows; ``shuffle=True`` reshuffles globally every epoch, exactly the
+    reference's epoch-end Alltoall."""
+
+    def __init__(
+        self,
+        dataset: Union[Dataset, DNDarray],
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+    ):
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset)
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator:
+        if self.shuffle:
+            self.dataset.shuffle()
+        n = len(self.dataset)
+        nbatches = len(self)
+        for i in range(nbatches):
+            lo = i * self.batch_size
+            hi = min(lo + self.batch_size, n)
+            yield self.dataset[lo:hi]
+
+
+def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Global in-place shuffle (reference: datatools.py:246)."""
+    dataset.shuffle()
+
+
+def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Non-blocking shuffle (reference: datatools.py:301). JAX dispatch is
+    asynchronous already, so this is the same call."""
+    dataset.shuffle()

@@ -1,0 +1,58 @@
+"""Sparse tests (reference model: heat/sparse/tests/, e.g.
+test_arithmetics.py)."""
+
+import numpy as np
+import scipy.sparse
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+def _random_csr(n, m, density=0.2, seed=0):
+    rng = np.random.default_rng(seed)
+    mat = scipy.sparse.random(n, m, density=density, random_state=rng, format="csr", dtype=np.float32)
+    return mat
+
+
+class TestSparse(TestCase):
+    def test_factory_and_metadata(self):
+        sp = _random_csr(10, 8, seed=1)
+        d = ht.sparse.sparse_csr_matrix(sp, split=0)
+        self.assertEqual(d.shape, (10, 8))
+        self.assertEqual(d.nnz, sp.nnz)
+        self.assertEqual(d.split, 0)
+        self.assertEqual(d.ndim, 2)
+        counts, displs = d.counts_displs_nnz()
+        self.assertEqual(sum(counts), sp.nnz)
+        np.testing.assert_array_equal(np.asarray(d.indptr), sp.indptr)
+        np.testing.assert_array_equal(np.asarray(d.indices), sp.indices)
+
+    def test_todense_roundtrip(self):
+        sp = _random_csr(9, 7, seed=2)
+        d = ht.sparse.sparse_csr_matrix(sp, split=0)
+        dense = d.todense()
+        self.assert_array_equal(dense, sp.toarray())
+        self.assertEqual(dense.split, 0)
+
+    def test_add_mul(self):
+        a = _random_csr(12, 6, seed=3)
+        b = _random_csr(12, 6, seed=4)
+        da = ht.sparse.sparse_csr_matrix(a, split=0)
+        db = ht.sparse.sparse_csr_matrix(b, split=0)
+        s = ht.sparse.add(da, db)
+        np.testing.assert_allclose(s.todense().numpy(), (a + b).toarray(), rtol=1e-5)
+        p = da * db
+        np.testing.assert_allclose(p.todense().numpy(), (a.multiply(b)).toarray(), rtol=1e-5)
+
+    def test_astype_and_dense_input(self):
+        dense = np.array([[1.0, 0.0], [0.0, 2.0]], dtype=np.float32)
+        d = ht.sparse.sparse_csr_matrix(dense)
+        self.assertEqual(d.nnz, 2)
+        d64 = d.astype(ht.float64)
+        self.assertIs(d64.dtype, ht.float64)
+
+    def test_shape_mismatch_raises(self):
+        a = ht.sparse.sparse_csr_matrix(_random_csr(4, 4))
+        b = ht.sparse.sparse_csr_matrix(_random_csr(4, 5))
+        with self.assertRaises(ValueError):
+            ht.sparse.add(a, b)
