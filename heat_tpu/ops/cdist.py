@@ -1,0 +1,104 @@
+"""Fused pairwise squared-Euclidean distance — KMeans' hot loop on the MXU.
+
+The reference computes ``cdist`` via torch's kernel inside a hand-written MPI
+ring (heat/spatial/distance.py:16-134, ``_quadratic_expand`` fast path).  On
+TPU the ring is GSPMD's problem (see heat_tpu/spatial/distance.py); this
+kernel fuses the quadratic expansion  ``|x|² + |y|² − 2·x·yᵀ``  so the norm
+terms ride along with the MXU matmul instead of separate HBM passes, and the
+sqrt happens before the tile leaves VMEM.
+
+Dispatch mirrors ops.matmul: Pallas on TPU, jnp expansion otherwise,
+``HEAT_TPU_PALLAS=interpret`` for interpreter-mode testing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .matmul import _mode, _pad_to
+
+__all__ = ["cdist"]
+
+
+def _cdist_kernel(x_ref, y_ref, o_ref, acc_ref, xn_ref, yn_ref, *, p_root: bool):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        xn_ref[:] = jnp.zeros_like(xn_ref)
+        yn_ref[:] = jnp.zeros_like(yn_ref)
+
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    acc_ref[:] += jax.lax.dot_general(
+        x, y, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    xn_ref[:] += jnp.sum(x * x, axis=1, keepdims=True)
+    yn_ref[:] += jnp.sum(y * y, axis=1, keepdims=True).T
+
+    @pl.when(kk == pl.num_programs(2) - 1)
+    def _():
+        d2 = jnp.maximum(xn_ref[:] + yn_ref[:] - 2.0 * acc_ref[:], 0.0)
+        o_ref[:] = (jnp.sqrt(d2) if p_root else d2).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sqrt", "block", "interpret"))
+def _cdist_pallas(x, y, sqrt=True, block=256, interpret=False):
+    m, d = x.shape
+    n, _ = y.shape
+    bm = min(block, max(8, m))
+    bn = min(block, max(128, n))
+    bk = min(512, max(128, d))
+    x = _pad_to(x, (bm, bk))
+    y = _pad_to(y, (bn, bk))
+    mp, dp = x.shape
+    np_, _ = y.shape
+    out = pl.pallas_call(
+        functools.partial(_cdist_kernel, p_root=sqrt),
+        grid=(mp // bm, np_ // bn, dp // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.VMEM((bm, 1), jnp.float32),
+            pltpu.VMEM((1, bn), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * np_ * dp,
+            bytes_accessed=(mp * dp + np_ * dp + mp * np_) * 4,
+            transcendentals=mp * np_,
+        ),
+        interpret=interpret,
+    )(x, y)
+    return out[:m, :n]
+
+
+def cdist(x: jax.Array, y: jax.Array, *, sqrt: bool = True) -> jax.Array:
+    """Pairwise (squared if ``sqrt=False``) Euclidean distances, (m,d)×(n,d)→(m,n)."""
+    if x.ndim != 2 or y.ndim != 2:
+        raise ValueError("cdist expects 2-D inputs")
+    mode = _mode()
+    if mode == "off":
+        x32 = x.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        d2 = (
+            jnp.sum(x32 * x32, axis=1, keepdims=True)
+            + jnp.sum(y32 * y32, axis=1)[None, :]
+            - 2.0 * x32 @ y32.T
+        )
+        d2 = jnp.maximum(d2, 0.0)
+        return jnp.sqrt(d2) if sqrt else d2
+    return _cdist_pallas(x, y, sqrt=sqrt, interpret=(mode == "interpret"))

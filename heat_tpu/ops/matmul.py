@@ -1,0 +1,103 @@
+"""Pallas tiled matmul — explicit VMEM blocking onto the MXU.
+
+The reference's GEMM hot loop is ATen's ``torch.matmul`` on each local tile
+under a hand-written block-cyclic MPI schedule (heat/core/linalg/basics.py:424,
+``__mm_c_block_setter`` basics.py:1980).  Here the distributed schedule belongs
+to GSPMD (see heat_tpu/core/linalg/basics.py); this kernel is the *per-chip*
+inner GEMM with K-innermost accumulation in an f32 VMEM scratch, used when the
+caller wants guaranteed blocking instead of trusting XLA's default tiling.
+
+Dispatch: Pallas-on-TPU when the backend is TPU; plain ``jnp.dot`` otherwise
+(tests run on a CPU mesh, where XLA's own GEMM is the right tool).  Set
+``HEAT_TPU_PALLAS=interpret`` to force the Pallas path through the interpreter
+for kernel-logic testing on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["matmul"]
+
+
+def _mode() -> str:
+    forced = os.environ.get("HEAT_TPU_PALLAS", "")
+    if forced in ("interpret", "tpu", "off"):
+        return forced
+    return "tpu" if jax.default_backend() == "tpu" else "off"
+
+
+def _pad_to(x: jax.Array, mults) -> jax.Array:
+    pads = [(0, (-d) % m) for d, m in zip(x.shape, mults)]
+    if any(p[1] for p in pads):
+        x = jnp.pad(x, pads)
+    return x
+
+
+def _mm_kernel(a_ref, b_ref, o_ref, acc_ref):
+    @pl.when(pl.program_id(2) == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_n", "block_k", "interpret"))
+def _mm_pallas(a, b, block_m=512, block_n=512, block_k=512, interpret=False):
+    m, k = a.shape
+    _, n = b.shape
+    bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
+    # MXU/VPU lane alignment (pallas_guide: min tile (8,128) f32 / (16,128) bf16)
+    sub = 16 if a.dtype == jnp.bfloat16 else 8
+    bm = max(sub, bm - bm % sub) if m >= sub else m
+    bk = max(128, bk - bk % 128) if k >= 128 else k
+    bn = max(128, bn - bn % 128) if n >= 128 else n
+    a = _pad_to(a, (bm, bk))
+    b = _pad_to(b, (bk, bn))
+    mp, kp = a.shape
+    _, np_ = b.shape
+    out = pl.pallas_call(
+        _mm_kernel,
+        grid=(mp // bm, np_ // bn, kp // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), a.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * np_ * kp,
+            bytes_accessed=(mp * kp + kp * np_ + mp * np_) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(a, b)
+    return out[:m, :n]
+
+
+def matmul(a: jax.Array, b: jax.Array, *, block: int = 512) -> jax.Array:
+    """2-D matmul with explicit MXU blocking (falls back to ``jnp.dot`` off-TPU)."""
+    if a.ndim != 2 or b.ndim != 2:
+        raise ValueError(f"pallas matmul is 2-D only, got {a.ndim}-D @ {b.ndim}-D")
+    mode = _mode()
+    if mode == "off":
+        return jnp.dot(a, b, preferred_element_type=a.dtype)
+    return _mm_pallas(
+        a, b, block_m=block, block_n=block, block_k=block, interpret=(mode == "interpret")
+    )

@@ -72,11 +72,24 @@ class DASO:
     phases), ``max_global_skips``, ``stability_level`` for the loss-based
     skip adaptation.
 
+    State layout: :meth:`init` stacks every parameter leaf with a leading
+    ``n_slices`` dimension sharded over the DCN axis, so slices hold (and
+    update) *their own* parameters and may diverge between global syncs —
+    the property DASO exploits.  :class:`heat_tpu.nn.DataParallelMultiGPU`
+    vmaps its train step over that leading dim; between syncs the only
+    collectives are intra-slice (ICI) gradient reductions.
+
     Usage::
 
         mesh = Mesh(devices.reshape(n_slices, per_slice), ("dcn", "ici"))
-        daso = DASO(DataParallelOptimizer(optax.sgd(0.1)), mesh=mesh, ...)
-        loss = daso.train_step(params_fn, batch, targets)  # see NN layer
+        comm = MeshComm(mesh, split_axis="ici")
+        daso = DASO(DataParallelOptimizer(optax.sgd(0.1)), mesh=mesh, comm=comm)
+        model = ht.nn.DataParallelMultiGPU(net, comm=comm, optimizer=daso)
+        model.init(0, sample_batch)
+        for epoch in range(epochs):
+            for batch, targets in loader:
+                loss = model.train_step(batch, targets)
+            daso.next_epoch(loss)
     """
 
     def __init__(
@@ -114,6 +127,48 @@ class DASO:
         self.batches_seen = 0
         self._last_losses = []
         self._sync_fn = None
+
+    @property
+    def n_slices(self) -> int:
+        """Number of DCN slices (reference: number of nodes, one MPI group
+        member per node, dp_optimizer.py:46)."""
+        return int(self.mesh.shape[self.dcn_axis]) if self.dcn_axis else 1
+
+    @property
+    def tx(self):
+        """The backing optax transformation (delegates to the local
+        optimizer so DASO is a drop-in for DataParallelOptimizer).  Must be
+        elementwise (sgd/momentum/adam/...) — a cross-leaf transform like
+        ``clip_by_global_norm`` would mix slice-stacked leaves."""
+        return self.local_optimizer.tx
+
+    @property
+    def state(self):
+        return self.local_optimizer.state
+
+    @state.setter
+    def state(self, value):
+        self.local_optimizer.state = value
+
+    def _bind_model(self, model) -> None:
+        self.local_optimizer._bind_model(model)
+
+    def stack_tree(self, tree):
+        """Give every leaf the leading n_slices dim, sharded over DCN."""
+        n = self.n_slices
+
+        def stack(x):
+            stacked = jnp.broadcast_to(x[None], (n,) + x.shape)
+            spec = P(*((self.dcn_axis,) + (None,) * x.ndim)) if self.dcn_axis else P()
+            return jax.device_put(stacked, NamedSharding(self.mesh, spec))
+
+        return jax.tree.map(stack, tree)
+
+    def init(self, params) -> None:
+        """Initialize local-optimizer state for the slice-stacked params.
+        ``params`` must already carry the leading n_slices dim (see
+        DataParallelMultiGPU.init)."""
+        self.local_optimizer.init(params)
 
     # ---------------------------------------------------------------- phases
     @property

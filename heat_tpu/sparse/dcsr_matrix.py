@@ -91,6 +91,10 @@ class DCSR_matrix:
 
     # ------------------------------------------------------- per-shard views
     def _row_range(self, rank: int) -> Tuple[int, int]:
+        # split=None means replicated: every rank's "local" view is the whole
+        # matrix (reference: local == global when not distributed)
+        if self.__split is None:
+            return 0, self.__gshape[0]
         off, lshape, _ = self.__comm.chunk(self.__gshape, 0, rank=rank)
         return off, off + lshape[0]
 

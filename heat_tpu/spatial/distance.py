@@ -56,12 +56,51 @@ def _sq_euclidean(xa, ya):
     return jnp.maximum(x2 + y2 - 2.0 * cross, 0.0)
 
 
+def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional[DNDarray]:
+    """Fused-kernel fast path for the KMeans shape: x row-split, y replicated.
+
+    Runs ops.cdist (Pallas, norms fused into the MXU matmul) on each shard
+    under shard_map — the TPU analog of the reference's stationary block with
+    a replicated small operand (distance.py:209, size-1 ring degenerate case).
+    Returns None when the layout doesn't fit, to fall through to GSPMD.
+    """
+    from ..ops.cdist import cdist as _fused
+    from ..ops.matmul import _mode
+    from ..parallel.collectives import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    # only when the promoted dtype is f32: the kernel accumulates and returns
+    # f32, and the GSPMD path must stay the dtype-authoritative fallback
+    if (
+        _mode() == "off"
+        or x.split != 0
+        or y.split is not None
+        or ya.dtype != jnp.float32
+    ):
+        return None
+    comm = x.comm
+    out = shard_map(
+        lambda xs, ys: _fused(xs, ys, sqrt=sqrt),
+        mesh=comm.mesh,
+        in_specs=(comm.spec(0, 2), P()),
+        out_specs=comm.spec(0, 2),
+        check_vma=False,
+    )(x.parray.astype(jnp.float32), ya)
+    gshape = (x.shape[0], y.shape[0])
+    return DNDarray(
+        out, gshape, types.canonical_heat_type(out.dtype), 0, x.device, x.comm
+    )
+
+
 def cdist(x: DNDarray, y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:
     """Euclidean distance matrix (reference: distance.py:136).
 
     ``quadratic_expansion`` is accepted for parity; on TPU the expansion is
     always used (it is the MXU path)."""
     x, y, xa, ya = _prep(x, y)
+    fast = _pallas_rowsplit_cdist(x, y, ya, sqrt=True)
+    if fast is not None:
+        return fast
     d = jnp.sqrt(_sq_euclidean(xa, ya))
     split = _result_split(x, y)
     out = DNDarray(d, tuple(d.shape), types.canonical_heat_type(d.dtype), split, x.device, x.comm)

@@ -78,6 +78,7 @@ class PartialH5DataLoaderIter:
     def __init__(self, dataset: PartialH5Dataset):
         self.dataset = dataset
         self._queue: "queue.Queue" = queue.Queue(maxsize=dataset.prefetch_depth)
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()
 
@@ -85,13 +86,17 @@ class PartialH5DataLoaderIter:
         import h5py
 
         ds = self.dataset
-        with h5py.File(ds.file, "r") as handle:
-            handles = [handle[name] for name in ds.dataset_names]
-            for lo in range(0, ds.total_size, ds.slab_rows):
-                hi = min(lo + ds.slab_rows, ds.total_size)
-                slab = tuple(np.asarray(h[lo:hi]) for h in handles)
-                self._queue.put(slab)
-        self._queue.put(None)
+        try:
+            with h5py.File(ds.file, "r") as handle:
+                handles = [handle[name] for name in ds.dataset_names]
+                for lo in range(0, ds.total_size, ds.slab_rows):
+                    hi = min(lo + ds.slab_rows, ds.total_size)
+                    slab = tuple(np.asarray(h[lo:hi]) for h in handles)
+                    self._queue.put(slab)
+        except BaseException as e:  # surface I/O errors to the consumer
+            self._error = e
+        finally:
+            self._queue.put(None)
 
     def __iter__(self):
         return self
@@ -99,6 +104,10 @@ class PartialH5DataLoaderIter:
     def __next__(self):
         slab = self._queue.get()
         if slab is None:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"background reader failed for {self.dataset.file!r}"
+                ) from self._error
             raise StopIteration
         # one host→device transfer per slab, sharded over the sample axis
         out = tuple(factories.array(part, split=0, comm=self.dataset.comm) for part in slab)
