@@ -162,13 +162,29 @@ def load_csv(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """CSV load (reference: io.py:713 — byte-range splitting per rank there;
-    a host-side parse + sharded placement here)."""
+    """CSV load (reference: io.py:713 — byte-range splitting per rank there).
+
+    Parsing goes through the native multi-threaded byte-range parser
+    (heat_tpu/native, the same line-alignment rule as the reference's
+    per-rank ranges) when available, with a NumPy fallback; placement onto
+    the mesh is one sharded device_put either way."""
     comm = sanitize_comm(comm)
     np_dtype = np.dtype(types.canonical_heat_type(dtype).jax_type())
-    arr = np.genfromtxt(
-        path, delimiter=sep, skip_header=header_lines, dtype=np_dtype, encoding=encoding
-    )
+    arr = None
+    if (
+        len(sep) == 1
+        and encoding in ("utf-8", "ascii", None)
+        and np_dtype == np.float32  # the native parser emits f32 exactly
+    ):
+        from .. import native
+
+        arr = native.csv_parse(path, header_lines=header_lines, sep=sep)
+        if arr is not None:
+            arr = np.squeeze(arr)  # match genfromtxt: 1-D for single col/row
+    if arr is None:
+        arr = np.genfromtxt(
+            path, delimiter=sep, skip_header=header_lines, dtype=np_dtype, encoding=encoding
+        )
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 

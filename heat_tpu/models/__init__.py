@@ -2,5 +2,10 @@
 
 from .mlp import MLP
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+from .transformer import TransformerLM, TransformerBlock
 
-__all__ = ["MLP", "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152"]
+__all__ = [
+    "MLP",
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
+    "TransformerLM", "TransformerBlock",
+]

@@ -1,0 +1,122 @@
+"""Decoder-only Transformer LM with mesh-parallel attention.
+
+No reference counterpart (Heat has no sequence models, SURVEY.md §5); this is
+the long-context flagship exercising the framework's sequence parallelism
+(heat_tpu/parallel/sequence.py) and the Pallas flash-attention kernel
+(heat_tpu/ops/attention.py).
+
+Parallelism is GSPMD-first: parameters carry no manual annotations — shard
+the inputs/params with a ``Mesh`` + ``PartitionSpec`` at the jit boundary
+(dp over batch, tp via XLA's sharding propagation through the Dense kernels)
+and set ``attention="ring"``/``"ulysses"`` with ``sp_mesh``/``sp_axis`` to
+run attention sequence-sharded (exact, memory O(seq/N) per device).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+__all__ = ["TransformerLM", "TransformerBlock"]
+
+
+class MultiHeadAttention(nn.Module):
+    """Causal MHA routed through flash attention, optionally sequence-parallel."""
+
+    num_heads: int
+    head_dim: int
+    attention: str = "flash"  # "flash" | "ring" | "ulysses"
+    sp_mesh: Optional[object] = None
+    sp_axis: str = "sp"
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.attention import flash_attention
+
+        b, s, _ = x.shape
+        h, d = self.num_heads, self.head_dim
+        qkv = nn.DenseGeneral((3, h, d), axis=-1, use_bias=False, name="qkv")(x)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)  # each (b, s, h, d)
+        q = q.transpose(0, 2, 1, 3)  # (b, h, s, d)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+        if self.attention in ("ring", "ulysses"):
+            from ..parallel.sequence import sequence_parallel_attention
+
+            if self.sp_mesh is None:
+                raise ValueError("sequence-parallel attention needs sp_mesh")
+            out = sequence_parallel_attention(
+                q, k, v, self.sp_mesh, self.sp_axis,
+                causal=True, strategy=self.attention,
+            )
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        return nn.DenseGeneral(x.shape[-1], axis=-1, use_bias=False, name="out")(out)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    attention: str = "flash"
+    sp_mesh: Optional[object] = None
+    sp_axis: str = "sp"
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(use_bias=False)(x)
+        x = x + MultiHeadAttention(
+            self.num_heads, self.head_dim,
+            attention=self.attention, sp_mesh=self.sp_mesh, sp_axis=self.sp_axis,
+            name="attn",
+        )(y)
+        y = nn.LayerNorm(use_bias=False)(x)
+        hidden = x.shape[-1] * self.mlp_ratio
+        y = nn.Dense(hidden, use_bias=False, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], use_bias=False, name="mlp_out")(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only language model.
+
+    ``remat=True`` checkpoints each block (jax.checkpoint) — the HBM/FLOPs
+    trade that makes long sequences fit.
+    """
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 64
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    attention: str = "flash"
+    sp_mesh: Optional[object] = None
+    sp_axis: str = "sp"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens):
+        emb = nn.Embed(self.vocab_size, self.num_heads * self.head_dim, name="embed")
+        x = emb(tokens)
+        pos = nn.Embed(self.max_seq_len, x.shape[-1], name="pos_embed")(
+            jnp.arange(tokens.shape[-1])[None, :]
+        )
+        x = x + pos
+        block = TransformerBlock
+        if self.remat:
+            block = nn.remat(TransformerBlock)
+        for i in range(self.num_layers):
+            x = block(
+                self.num_heads, self.head_dim, self.mlp_ratio,
+                attention=self.attention, sp_mesh=self.sp_mesh, sp_axis=self.sp_axis,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(use_bias=False, name="final_norm")(x)
+        # weight-tied readout
+        return emb.attend(x)

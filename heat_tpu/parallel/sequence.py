@@ -1,0 +1,208 @@
+"""Sequence / context parallelism: ring attention and Ulysses all-to-all.
+
+No reference counterpart — Heat has no attention or sequence models at all
+(SURVEY.md §5, "long-context: absent"); its closest primitives are the ring
+dataflow of ``heat/spatial/distance.py:209`` and the halo exchange of
+``heat/core/dndarray.py:383``, which generalize to exactly these patterns.
+This module supplies the missing long-context capability TPU-first:
+
+* :func:`ring_attention` — blockwise attention over a sequence-sharded
+  mesh axis.  K/V shards rotate around the ring via ``ppermute`` (ICI
+  neighbor links) while each device accumulates online-softmax statistics
+  for its resident Q shard: memory O(seq/N) per device, compute overlapped
+  with the rotation by XLA's scheduler.  Exact — not an approximation.
+* :func:`ulysses_attention` — the all-to-all alternative: resharding from
+  sequence-sharded to head-sharded (one ``all_to_all``), full-sequence
+  attention per local head group, and the inverse reshard.  Cheaper at
+  moderate sequence lengths when heads ≥ mesh size; ring wins when seq is
+  huge or heads are few.
+
+Both are *shard-level* functions (call under ``shard_map`` with ``q, k, v``
+sharded along the sequence dim) — :func:`sequence_parallel_attention` is the
+array-level wrapper that sets up the shard_map.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import shard_map
+
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "sequence_parallel_attention",
+]
+
+_NEG_INF = -1e30
+
+
+def _block_stats(q, k, v, scale, mask):
+    """Unnormalized attention of one (Q-shard, K/V-shard) block pair.
+
+    Returns running-max ``m`` (…, sq, 1), normalizer ``l`` (…, sq, 1) and
+    unnormalized output ``o`` (…, sq, d) for online-softmax combination."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # guard fully-masked rows: exp(-inf - -inf) → nan otherwise
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return m_safe, l, o
+
+
+def _combine(m1, l1, o1, m2, l2, o2):
+    """Merge two online-softmax partials (the flash-attention combine rule)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return m, l1 * a1 + l2 * a2, o1 * a1 + o2 * a2
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Exact attention over a sequence sharded along ``axis_name``
+    (shard-level; call inside ``shard_map``).
+
+    ``q, k, v``: ``(..., seq_local, head_dim)``.  Device ``i`` holds global
+    sequence rows ``[i*seq_local, (i+1)*seq_local)``.  Each of the ``N`` ring
+    steps attends the resident Q block to one K/V block, then rotates K/V one
+    position down the ring (``ppermute`` on neighboring ICI links) — the
+    Ring Attention schedule (Liu et al., 2023), built from the same ring
+    dataflow as the reference's pairwise-distance loop
+    (heat/spatial/distance.py:209)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    sq = q.shape[-2]
+    sk = k.shape[-2]
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+
+    q_pos = idx * sq + jnp.arange(sq)[:, None]  # global row ids (sq, 1)
+
+    bshape = q.shape[:-2]
+    m0 = jnp.full(bshape + (sq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(bshape + (sq, 1), jnp.float32)
+    o0 = jnp.zeros(bshape + (sq, d), jnp.float32)
+
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def step(carry, r):
+        m, l, o, kb, vb = carry
+        # K/V block r came from device (idx + r) mod n
+        src = (idx + r) % n
+        k_pos = src * sk + jnp.arange(sk)[None, :]  # (1, sk)
+        mask = jnp.ones((sq, sk), bool)
+        if causal:
+            mask = q_pos >= k_pos
+        mb, lb, ob = _block_stats(q, kb, vb, scale, mask)
+        m, l, o = _combine(m, l, o, mb, lb, ob)
+        # rotate K/V to the next device (skip the final, unused rotation is
+        # harmless under scan's static trip count)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (m, l, o, kb, vb), None
+
+    (m, l, o, _, _), _ = lax.scan(step, (m0, l0, o0, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zero output
+    return (o / l).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style; shard-level).
+
+    ``q, k, v``: ``(heads, seq_local, head_dim)`` with heads divisible by the
+    axis size.  One ``all_to_all`` swaps the sharded dim from sequence to
+    heads, each device runs full-sequence attention for its head group
+    (through the Pallas flash kernel on TPU), and the inverse ``all_to_all``
+    restores sequence sharding."""
+    from ..ops.attention import flash_attention
+
+    n = lax.axis_size(axis_name)
+    h = q.shape[0]
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by mesh axis size {n}")
+
+    def seq_to_head(x):
+        # (h, s_loc, d) → (h/n, s_glob, d)
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0, tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    return head_to_seq(out)
+
+
+def sequence_parallel_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    strategy: str = "ring",
+):
+    """Array-level entry: attention with the sequence dim sharded over
+    ``axis_name``.
+
+    ``q, k, v``: ``(batch, heads, seq, head_dim)`` global arrays; the ``seq``
+    dim is (re)sharded over ``axis_name``.  ``strategy`` is ``"ring"`` or
+    ``"ulysses"``."""
+    if strategy not in ("ring", "ulysses"):
+        raise ValueError(f"unknown strategy {strategy!r}")
+    spec = P(None, None, axis_name, None)
+
+    if strategy == "ring":
+
+        def fn(qs, ks, vs):
+            return ring_attention(qs, ks, vs, axis_name, causal=causal)
+
+    else:
+
+        def fn(qs, ks, vs):
+            # fold batch into heads for the (h, s, d) shard-level layout
+            b, h, s, d = qs.shape
+
+            def one(x):
+                return x.reshape(b * h, s, d)
+
+            out = ulysses_attention(
+                one(qs), one(ks), one(vs), axis_name, causal=causal
+            )
+            return out.reshape(b, h, s, d)
+
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

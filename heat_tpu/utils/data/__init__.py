@@ -5,12 +5,14 @@ from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
 from .matrixgallery import parter
 from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
 from .spherical import create_spherical_dataset
+from ...native import PrefetchPipeline
 
 __all__ = [
     "DataLoader",
     "Dataset",
     "PartialH5Dataset",
     "PartialH5DataLoaderIter",
+    "PrefetchPipeline",
     "create_spherical_dataset",
     "dataset_ishuffle",
     "dataset_shuffle",
