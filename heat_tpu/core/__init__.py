@@ -63,5 +63,6 @@ from .io import *
 from . import signal
 from .signal import *
 from . import tiling
+from .tiling import *
 from . import linalg
 from .linalg import *

@@ -14,4 +14,26 @@ nan = NAN
 pi = PI
 e = E
 
-__all__ = ["e", "inf", "nan", "pi", "E", "INF", "NAN", "NINF", "PI"]
+# capitalized aliases (reference: constants.py:7,17-39)
+Euler = E
+Inf = INF
+Infty = INF
+Infinity = INF
+NaN = NAN
+
+__all__ = [
+    "e",
+    "Euler",
+    "inf",
+    "Inf",
+    "Infty",
+    "Infinity",
+    "nan",
+    "NaN",
+    "pi",
+    "E",
+    "INF",
+    "NAN",
+    "NINF",
+    "PI",
+]

@@ -16,9 +16,11 @@ from .dndarray import DNDarray
 
 __all__ = [
     "sanitize_in",
+    "sanitize_infinity",
     "sanitize_out",
     "sanitize_distribution",
     "sanitize_lshape",
+    "sanitize_sequence",
     "scalar_to_1d",
     "sanitize_in_tensor",
 ]
@@ -73,6 +75,25 @@ def sanitize_distribution(*args: DNDarray, target: DNDarray, diff_map=None):
 
             out.append(manipulations.resplit(x, target.split))
     return out[0] if len(out) == 1 else tuple(out)
+
+
+def sanitize_infinity(x) -> Union[int, float]:
+    """Largest representable value for the dtype of ``x`` (reference:
+    sanitation.py:177); used to substitute infinity in integer contexts."""
+    dtype = np.dtype(x.larray.dtype if isinstance(x, DNDarray) else x.dtype)
+    if np.issubdtype(dtype, np.floating):
+        return float(np.finfo(dtype).max)
+    return int(np.iinfo(dtype).max)
+
+
+def sanitize_sequence(seq) -> list:
+    """Validate that ``seq`` is a list or tuple, return a list (reference:
+    sanitation.py:351)."""
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    raise TypeError(f"seq must be a list or a tuple, got {type(seq)}")
 
 
 def sanitize_lshape(array: DNDarray, tensor) -> None:

@@ -16,7 +16,7 @@ import numpy as np
 
 from .dndarray import DNDarray
 
-__all__ = ["SplitTiles"]
+__all__ = ["SplitTiles", "SquareDiagTiles"]
 
 
 class SplitTiles:
@@ -70,3 +70,244 @@ class SplitTiles:
     def __getitem__(self, key):
         """Read a tile's data by device rank along the split dim."""
         return self.__arr.larray[self.tile_ranges(key if isinstance(key, int) else key[0])]
+
+
+class SquareDiagTiles:
+    """Diagonal-anchored 2-D tile grid (reference: tiling.py:331-1257).
+
+    The reference uses this as the *scheduler substrate* for its tiled QR —
+    tile maps drive hand-written Send/Recv rings.  The TPU rebuild's QR is a
+    shard_map TSQR tree, so here the class is pure metadata + global-index
+    tile access: the tile grid subdivides each device chunk along the split
+    axis into ``tiles_per_proc`` tiles and anchors the perpendicular borders
+    to the main diagonal, exactly like the reference's tile geometry.
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
+        if arr.ndim != 2:
+            raise ValueError(f"arr must be 2-D, got {arr.ndim}-D")
+        if tiles_per_proc < 1:
+            raise ValueError("tiles_per_proc must be >= 1")
+        if arr.split not in (0, 1):
+            raise ValueError("arr must be split along axis 0 or 1")
+        self.__arr = arr
+        self.__tiles_per_proc = tiles_per_proc
+        m, n = arr.shape
+        comm = arr.comm
+        nproc = comm.size
+
+        # split-axis tile borders: each device chunk divided into
+        # tiles_per_proc near-equal tiles (reference: tiling.py:376-520)
+        split_edges = [0]
+        owners = []
+        for r in range(nproc):
+            off, lshape, _ = comm.chunk(arr.shape, arr.split, rank=r)
+            ln = lshape[arr.split]
+            base, rem = divmod(ln, tiles_per_proc)
+            pos = off
+            for t in range(tiles_per_proc):
+                sz = base + (1 if t < rem else 0)
+                if sz == 0:
+                    continue
+                pos += sz
+                split_edges.append(pos)
+                owners.append(r)
+        # perpendicular borders: anchored to the diagonal — reuse the split
+        # edges clipped to the diagonal length, then one trailing tile for
+        # any off-diagonal remainder (reference: tiling.py:520-610)
+        diag = min(m, n)
+        perp_len = n if arr.split == 0 else m
+        perp_edges = sorted({min(x, diag) for x in split_edges} | {perp_len})
+
+        if arr.split == 0:
+            row_edges, col_edges = split_edges, perp_edges
+        else:
+            row_edges, col_edges = perp_edges, split_edges
+        self.__row_inds = [int(x) for x in row_edges[:-1]]
+        self.__col_inds = [int(x) for x in col_edges[:-1]]
+        self.__row_edges = [int(x) for x in row_edges]
+        self.__col_edges = [int(x) for x in col_edges]
+
+        # tile ownership map: (row, col, 3) — last dim holds (h, w, rank)
+        # like the reference's tile_map (tiling.py:430)
+        nrows, ncols = len(self.__row_inds), len(self.__col_inds)
+        tmap = np.zeros((nrows, ncols, 3), dtype=np.int64)
+        for i in range(nrows):
+            for j in range(ncols):
+                tmap[i, j, 0] = self.__row_edges[i + 1] - self.__row_edges[i]
+                tmap[i, j, 1] = self.__col_edges[j + 1] - self.__col_edges[j]
+                tmap[i, j, 2] = owners[i if arr.split == 0 else j]
+        self.__tile_map = tmap
+        self.__owners = owners
+
+        # last process holding any diagonal tile (reference: tiling.py:620)
+        ldp = 0
+        for k, edge in enumerate(split_edges[:-1]):
+            if edge < diag:
+                ldp = owners[k]
+        self.__last_diag_pr = ldp
+
+    # ------------------------------------------------------------ properties
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tiles_per_proc(self) -> int:
+        return self.__tiles_per_proc
+
+    @property
+    def row_indices(self) -> list:
+        """Global start row of each tile row (reference: tiling.py row_indices)."""
+        return list(self.__row_inds)
+
+    @property
+    def col_indices(self) -> list:
+        """Global start column of each tile column."""
+        return list(self.__col_inds)
+
+    @property
+    def tile_rows(self) -> int:
+        return len(self.__row_inds)
+
+    @property
+    def tile_columns(self) -> int:
+        return len(self.__col_inds)
+
+    @property
+    def tile_map(self) -> np.ndarray:
+        """(rows, cols, 3) array of (height, width, owner-rank) per tile."""
+        return self.__tile_map
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        return self.__arr.lshape_map
+
+    @property
+    def last_diagonal_process(self) -> int:
+        return self.__last_diag_pr
+
+    @property
+    def tile_rows_per_process(self) -> list:
+        if self.__arr.split == 0:
+            counts = [0] * self.__arr.comm.size
+            for r in self.__owners:
+                counts[r] += 1
+            return counts
+        return [self.tile_rows] * self.__arr.comm.size
+
+    @property
+    def tile_columns_per_process(self) -> list:
+        if self.__arr.split == 1:
+            counts = [0] * self.__arr.comm.size
+            for r in self.__owners:
+                counts[r] += 1
+            return counts
+        return [self.tile_columns] * self.__arr.comm.size
+
+    # ------------------------------------------------------------ access
+    def get_start_stop(self, key) -> Tuple[int, int, int, int]:
+        """(row_start, row_stop, col_start, col_stop) of tile ``key=(i, j)``
+        in global indices (reference: tiling.py:824)."""
+        i, j = key
+        if i < 0:
+            i += self.tile_rows
+        if j < 0:
+            j += self.tile_columns
+        return (
+            self.__row_edges[i],
+            self.__row_edges[i + 1],
+            self.__col_edges[j],
+            self.__col_edges[j + 1],
+        )
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            key = (key, slice(None))
+        i, j = key
+        rs = self.__slice(self.__row_edges, i, self.tile_rows)
+        cs = self.__slice(self.__col_edges, j, self.tile_columns)
+        return self.__arr.larray[rs, cs]
+
+    def __setitem__(self, key, value):
+        if isinstance(key, int):
+            key = (key, slice(None))
+        i, j = key
+        rs = self.__slice(self.__row_edges, i, self.tile_rows)
+        cs = self.__slice(self.__col_edges, j, self.tile_columns)
+        self.__arr.larray = self.__arr.larray.at[rs, cs].set(value)
+
+    @staticmethod
+    def __slice(edges, k, ntiles) -> slice:
+        if isinstance(k, slice):
+            start, stop, step = k.indices(ntiles)
+            if step != 1:
+                raise ValueError("tile slices must be contiguous")
+            return slice(edges[start], edges[stop])
+        if k < 0:
+            k += ntiles
+        return slice(edges[k], edges[k + 1])
+
+    def local_get(self, key):
+        """Tile data by process-local tile index (reference: tiling.py:939);
+        under the single-controller model local and global indices coincide
+        for the one addressable process."""
+        return self[self.__local_to_global(key)]
+
+    def local_set(self, key, value) -> None:
+        self[self.__local_to_global(key)] = value
+
+    def __local_to_global(self, key):
+        if isinstance(key, int):
+            key = (key, slice(None))
+        i, j = key
+        rank = self.__arr.comm.rank
+        if self.__arr.split == 0 and isinstance(i, int) and i >= 0:
+            i += self.__first_tile(rank)
+        elif self.__arr.split == 1 and isinstance(j, int) and j >= 0:
+            j += self.__first_tile(rank)
+        return (i, j)
+
+    def __first_tile(self, rank: int) -> int:
+        for k, r in enumerate(self.__owners):
+            if r == rank:
+                return k
+        return 0
+
+    def match_tiles(self, other: "SquareDiagTiles") -> None:
+        """Align this grid's diagonal-anchored borders with ``other``'s where
+        the shapes allow (reference: tiling.py:1084, used to keep Q's tiles
+        congruent with R's during the tiled QR)."""
+        arr = self.__arr
+        m, n = arr.shape
+        row_edges = sorted({min(e, m) for e in other.__row_edges} | {0, m})
+        col_edges = sorted({min(e, n) for e in other.__col_edges} | {0, n})
+        self.__row_edges = row_edges
+        self.__col_edges = col_edges
+        self.__row_inds = row_edges[:-1]
+        self.__col_inds = col_edges[:-1]
+        # re-derive tile ownership for the new grid: a split-axis tile is
+        # owned by the rank whose chunk contains its start index
+        split_edges = row_edges if arr.split == 0 else col_edges
+        chunk_ends = []
+        for r in range(arr.comm.size):
+            off, lshape, _ = arr.comm.chunk(arr.shape, arr.split, rank=r)
+            chunk_ends.append(off + lshape[arr.split])
+        owners = []
+        for start in split_edges[:-1]:
+            owners.append(next(r for r, e in enumerate(chunk_ends) if start < e))
+        self.__owners = owners
+        diag = min(m, n)
+        ldp = 0
+        for k, edge in enumerate(split_edges[:-1]):
+            if edge < diag:
+                ldp = owners[k]
+        self.__last_diag_pr = ldp
+        nrows, ncols = len(self.__row_inds), len(self.__col_inds)
+        tmap = np.zeros((nrows, ncols, 3), dtype=np.int64)
+        for i in range(nrows):
+            for j in range(ncols):
+                tmap[i, j, 0] = row_edges[i + 1] - row_edges[i]
+                tmap[i, j, 1] = col_edges[j + 1] - col_edges[j]
+                tmap[i, j, 2] = owners[i if arr.split == 0 else j]
+        self.__tile_map = tmap

@@ -57,6 +57,7 @@ __all__ = [
     "double",
     "complex64",
     "cfloat",
+    "csingle",
     "complex128",
     "cdouble",
     "flexible",
@@ -250,6 +251,7 @@ class complex64(complexfloating):
 
 
 cfloat = complex64
+csingle = complex64
 
 
 class complex128(complexfloating):

@@ -47,10 +47,14 @@ _build_failed = False
 def _needs_build() -> bool:
     if not os.path.exists(_SO):
         return True
-    so_mtime = os.path.getmtime(_SO)
-    return any(
-        os.path.getmtime(os.path.join(_SRC, s)) > so_mtime for s in _SOURCES
-    )
+    try:
+        so_mtime = os.path.getmtime(_SO)
+        return any(
+            os.path.getmtime(os.path.join(_SRC, s)) > so_mtime for s in _SOURCES
+        )
+    except OSError:
+        # sources stripped from the install; use the prebuilt .so as-is
+        return False
 
 
 def _build() -> bool:

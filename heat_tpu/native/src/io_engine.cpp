@@ -68,8 +68,10 @@ void parse_chunk(const char* data, long n, char delim,
   while (p < lim) {
     const char* nl = (const char*)memchr(p, '\n', lim - p);
     const char* line_end = nl ? nl : lim;
-    // strip trailing '\r' (CRLF files)
+    // truncate at '#' (np.genfromtxt comments='#'), strip trailing '\r'/ws
     const char* le = line_end;
+    const char* hash = (const char*)memchr(p, '#', line_end - p);
+    if (hash) le = hash;
     while (le > p && (le[-1] == '\r' || le[-1] == ' ' || le[-1] == '\t')) --le;
     if (le > p) {
       long line_cols = 0;

@@ -56,15 +56,23 @@ void ht_threefry_fill_u64(uint64_t seed, uint64_t counter, long n,
     ws.emplace_back([=]() {
       long lo = t * per;
       long hi = lo + per < n ? lo + per : n;
-      // pairing is keyed to the ABSOLUTE even index so the stream is
-      // identical for any thread count: out[2j] = o0 of pair (2j, 2j+1),
-      // out[2j+1] = o1 of that pair, regardless of which thread emits it
-      for (long base = lo & ~1L; base < hi; base += 2) {
+      // pairing is keyed to the ABSOLUTE even counter value so the stream
+      // is a pure function of (seed, counter+index) for any thread count
+      // AND any segmentation: the element at absolute counter c is always
+      // lane (c & 1) of the Threefry block over (c & ~1, c & ~1 | 1)
+      for (long i = lo; i < hi;) {
+        uint64_t c = counter + (uint64_t)i;
+        uint64_t base = c & ~1ULL;
         uint64_t o0, o1;
-        threefry2x64(seed, 0, counter + (uint64_t)base,
-                     counter + (uint64_t)base + 1, &o0, &o1);
-        if (base >= lo) out[base] = o0;
-        if (base + 1 >= lo && base + 1 < hi) out[base + 1] = o1;
+        threefry2x64(seed, 0, base, base | 1, &o0, &o1);
+        if (c == base) {
+          out[i] = o0;
+          if (i + 1 < hi) out[i + 1] = o1;
+          i += 2;
+        } else {
+          out[i] = o1;
+          i += 1;
+        }
       }
     });
   }

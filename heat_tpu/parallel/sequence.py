@@ -178,7 +178,10 @@ def sequence_parallel_attention(
     ``"ulysses"``."""
     if strategy not in ("ring", "ulysses"):
         raise ValueError(f"unknown strategy {strategy!r}")
-    spec = P(None, None, axis_name, None)
+    # batch rides the remaining mesh axes (dp) so each dp group keeps its own
+    # batch shard; only the sequence dim is gathered/rotated over axis_name
+    other = tuple(a for a in mesh.axis_names if a != axis_name)
+    spec = P(other if other else None, None, axis_name, None)
 
     if strategy == "ring":
 

@@ -190,3 +190,69 @@ class TestIndexingOps(TestCase):
         x = ht.array(data, split=0)
         nz = ht.nonzero(x)
         self.assert_array_equal(nz, np.stack(np.nonzero(data), axis=1))
+
+
+class TestConstantsSanitation(TestCase):
+    def test_constant_aliases(self):
+        self.assertEqual(ht.Euler, ht.e)
+        self.assertEqual(ht.Inf, ht.inf)
+        self.assertEqual(ht.Infty, ht.inf)
+        self.assertEqual(ht.Infinity, ht.inf)
+        self.assertTrue(np.isnan(ht.NaN))
+        self.assertIs(ht.csingle, ht.complex64)
+
+    def test_sanitize_infinity(self):
+        x = ht.ones(4, dtype=ht.float32)
+        self.assertEqual(ht.sanitize_infinity(x), float(np.finfo(np.float32).max))
+        y = ht.ones(4, dtype=ht.int32)
+        self.assertEqual(ht.sanitize_infinity(y), np.iinfo(np.int32).max)
+
+    def test_sanitize_sequence(self):
+        self.assertEqual(ht.sanitize_sequence((1, 2)), [1, 2])
+        self.assertEqual(ht.sanitize_sequence([3]), [3])
+        with self.assertRaises(TypeError):
+            ht.sanitize_sequence(np.arange(3))
+
+
+class TestTiling(TestCase):
+    def test_split_tiles(self):
+        x = ht.random.randn(16, 4, split=0)
+        tiles = ht.SplitTiles(x)
+        dims = tiles.tile_dimensions
+        self.assertEqual(int(np.sum(dims[0])), 16)
+        self.assertEqual(int(np.sum(dims[1])), 4)
+
+    def test_square_diag_tiles_split0(self):
+        x = ht.random.randn(24, 8, split=0)
+        t = ht.SquareDiagTiles(x, tiles_per_proc=2)
+        # borders tile the full matrix
+        rs, re, cs, ce = t.get_start_stop((0, 0))
+        self.assertEqual((rs, cs), (0, 0))
+        self.assertEqual(sum(t.tile_map[i, 0, 0] for i in range(t.tile_rows)), 24)
+        self.assertEqual(sum(t.tile_map[0, j, 1] for j in range(t.tile_columns)), 8)
+        # read/write round-trip on a tile
+        tile = np.asarray(t[0, 0])
+        t[0, 0] = np.zeros_like(tile)
+        self.assertTrue(np.all(np.asarray(t[0, 0]) == 0))
+        self.assertEqual(len(t.tile_rows_per_process), self.comm.size)
+
+    def test_square_diag_tiles_split1(self):
+        x = ht.random.randn(8, 24, split=1)
+        t = ht.SquareDiagTiles(x, tiles_per_proc=1)
+        self.assertEqual(sum(t.tile_map[0, j, 1] for j in range(t.tile_columns)), 24)
+        q = ht.random.randn(8, 8, split=1)
+        tq = ht.SquareDiagTiles(q, tiles_per_proc=1)
+        tq.match_tiles(t)
+        self.assertEqual(tq.row_indices[0], 0)
+
+    def test_match_tiles_reowns_tiles(self):
+        """match_tiles must rebuild tile ownership for the new grid (review
+        regression: owner column was zeroed and per-process counts stale)."""
+        x = ht.random.randn(24, 8, split=0)
+        t = ht.SquareDiagTiles(x, tiles_per_proc=2)
+        q = ht.random.randn(24, 24, split=0)
+        tq = ht.SquareDiagTiles(q, tiles_per_proc=1)
+        tq.match_tiles(t)
+        owners = tq.tile_map[:, 0, 2]
+        self.assertEqual(int(owners[-1]), self.comm.size - 1)
+        self.assertEqual(sum(tq.tile_rows_per_process), tq.tile_rows)

@@ -169,3 +169,31 @@ class TestNativeThreefry(TestCase):
         np.testing.assert_array_equal(p1, p2)
         self.assertEqual(sorted(p1.tolist()), list(range(1000)))
         self.assertFalse(np.array_equal(p1, np.arange(1000)))
+
+
+class TestNativeRegressions(TestCase):
+    @needs_native
+    def test_csv_comment_lines_skipped(self):
+        """'#' comments must match np.genfromtxt semantics (review
+        regression: comment lines used to parse as NaN rows)."""
+        import tempfile, os
+
+        with tempfile.NamedTemporaryFile("w", suffix=".csv", delete=False) as f:
+            f.write("# a, b, c\n1,2,3\n4,5,6  # trailing\n# done\n")
+            path = f.name
+        try:
+            got = native.csv_parse(path, header_lines=0, sep=",")
+            exp = np.genfromtxt(path, delimiter=",", dtype=np.float32)
+            np.testing.assert_allclose(got, exp)
+        finally:
+            os.unlink(path)
+
+    @needs_native
+    def test_threefry_stream_segment_consistency(self):
+        """Resuming the stream at an odd counter must reproduce the
+        contiguous draw (review regression: pairing was keyed to the local
+        index, shifting odd-offset segments)."""
+        whole = native.threefry_fill(9, 0, 64)
+        for off in (1, 3, 17):
+            seg = native.threefry_fill(9, off, 64 - off)
+            np.testing.assert_array_equal(whole[off:], seg)
