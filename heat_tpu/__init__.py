@@ -41,6 +41,7 @@ from .core import (
 from .core.version import __version__
 from . import parallel
 from . import cluster
+from . import datasets
 from . import classification
 from . import graph
 from . import naive_bayes

@@ -46,7 +46,13 @@ try:
 
     __NETCDF = True
 except ImportError:
+    netCDF4 = None
     __NETCDF = False
+
+try:
+    from scipy.io import netcdf_file as __scipy_netcdf
+except ImportError:
+    __scipy_netcdf = None
 
 
 def supports_hdf5() -> bool:
@@ -55,8 +61,9 @@ def supports_hdf5() -> bool:
 
 
 def supports_netcdf() -> bool:
-    """True iff netCDF4 is importable (reference: io.py feature probe)."""
-    return __NETCDF
+    """True iff a NetCDF backend is importable (reference: io.py feature
+    probe); netCDF4 when present, else scipy's classic-format reader."""
+    return __NETCDF or __scipy_netcdf is not None
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:
@@ -132,18 +139,32 @@ def load_netcdf(
     comm=None,
 ) -> DNDarray:
     """NetCDF load (reference: io.py:268)."""
-    if not __NETCDF:
-        raise RuntimeError("netCDF4 is not available")
     comm = sanitize_comm(comm)
-    with netCDF4.Dataset(path, "r") as handle:
-        arr = np.asarray(handle.variables[variable][:])
+    if __NETCDF:
+        with netCDF4.Dataset(path, "r") as handle:
+            arr = np.asarray(handle.variables[variable][:])
+    elif __scipy_netcdf is not None:
+        with __scipy_netcdf(path, "r", mmap=False) as handle:
+            arr = np.asarray(handle.variables[variable][:])
+    else:
+        raise RuntimeError("no NetCDF backend (netCDF4 or scipy) is available")
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 
 def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
     """NetCDF save (reference: io.py:351)."""
     if not __NETCDF:
-        raise RuntimeError("netCDF4 is not available")
+        if __scipy_netcdf is not None and mode == "w":
+            arr = data.numpy()
+            with __scipy_netcdf(path, "w") as handle:
+                for i, dim in enumerate(arr.shape):
+                    handle.createDimension(f"dim_{i}", dim)
+                var = handle.createVariable(
+                    variable, arr.dtype.char, tuple(f"dim_{i}" for i in range(arr.ndim))
+                )
+                var[:] = arr
+            return
+        raise RuntimeError("no NetCDF backend (netCDF4 or scipy) is available")
     with netCDF4.Dataset(path, mode) as handle:
         arr = data.numpy()
         for i, dim in enumerate(arr.shape):

@@ -1,0 +1,11 @@
+"""Bundled sample datasets (reference: heat/datasets/__init__.py).
+
+Synthetic, license-clean stand-ins with the reference's exact file schema
+(names, shapes, separators, HDF5/NetCDF keys); see ``_generate.py``.
+"""
+
+import os
+
+path = os.path.dirname(os.path.abspath(__file__))
+
+__all__ = ["path"]

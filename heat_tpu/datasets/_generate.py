@@ -1,0 +1,102 @@
+"""Regenerate the bundled sample datasets (deterministic).
+
+The reference ships Fisher-iris and a diabetes regression set
+(heat/datasets/: iris.csv, iris.h5, iris.nc, iris_X_train.csv, ...,
+diabetes.h5) as sample data for tests and examples.  This rebuild bundles
+**license-clean synthetic stand-ins with identical schema**: same file
+names, shapes, separators, and dataset/variable keys, drawn from a fixed
+seed — so every `ht.load(...)` flow a reference user knows works unchanged.
+
+Run ``python -m heat_tpu.datasets._generate`` to rewrite the files.
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def make_iris(rng: np.random.Generator) -> tuple:
+    """150x4 three-cluster data in the iris value ranges + labels 0/1/2."""
+    centers = np.array(
+        [
+            [5.0, 3.4, 1.5, 0.25],
+            [5.9, 2.8, 4.3, 1.3],
+            [6.6, 3.0, 5.6, 2.0],
+        ]
+    )
+    scales = np.array(
+        [
+            [0.35, 0.38, 0.17, 0.10],
+            [0.52, 0.31, 0.47, 0.20],
+            [0.64, 0.32, 0.55, 0.27],
+        ]
+    )
+    X = np.concatenate(
+        [rng.normal(centers[i], scales[i], size=(50, 4)) for i in range(3)]
+    )
+    X = np.round(np.clip(X, 0.1, None), 1)
+    y = np.repeat(np.arange(3), 50)
+    return X.astype(np.float64), y.astype(np.int64)
+
+
+def make_diabetes(rng: np.random.Generator) -> tuple:
+    """442x11 standardized design matrix (intercept column first, like the
+    reference's diabetes.h5 'x') and a noisy linear response 'y'."""
+    n, f = 442, 10
+    X = rng.normal(0.0, 0.047, size=(n, f))
+    X -= X.mean(axis=0)
+    X /= np.sqrt((X**2).sum(axis=0))
+    coef = rng.normal(0.0, 300.0, size=f)
+    y = 152.0 + X @ coef + rng.normal(0.0, 54.0, size=n)
+    Xi = np.concatenate([np.ones((n, 1)), X], axis=1)
+    return Xi.astype(np.float64), y.astype(np.float64).reshape(-1, 1)
+
+
+def main() -> None:
+    rng = np.random.default_rng(20260729)
+    X, y = make_iris(rng)
+
+    # iris.csv: ';'-separated, 1 decimal, no header (reference schema)
+    np.savetxt(os.path.join(HERE, "iris.csv"), X, delimiter=";", fmt="%.1f")
+    np.savetxt(os.path.join(HERE, "iris_labels.csv"), y, fmt="%d")
+
+    # fixed 100/50 train/test split, interleaved so classes stay balanced
+    idx = rng.permutation(150)
+    tr, te = idx[:100], idx[100:]
+    np.savetxt(os.path.join(HERE, "iris_X_train.csv"), X[tr][:, :], delimiter=";", fmt="%.1f")
+    np.savetxt(os.path.join(HERE, "iris_X_test.csv"), X[te][:, :], delimiter=";", fmt="%.1f")
+    np.savetxt(os.path.join(HERE, "iris_y_train.csv"), y[tr], fmt="%d")
+    np.savetxt(os.path.join(HERE, "iris_y_test.csv"), y[te], fmt="%d")
+    # class-probability table for the test rows (rows sum to 1)
+    logits = rng.normal(0, 1, size=(150, 3)) + np.eye(3)[y] * 3.0
+    proba = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    np.savetxt(os.path.join(HERE, "iris_y_pred_proba.csv"), proba, delimiter=";", fmt="%.8f")
+
+    try:
+        import h5py
+
+        with h5py.File(os.path.join(HERE, "iris.h5"), "w") as f:
+            f.create_dataset("data", data=X)
+        Xd, yd = make_diabetes(rng)
+        with h5py.File(os.path.join(HERE, "diabetes.h5"), "w") as f:
+            f.create_dataset("x", data=Xd)
+            f.create_dataset("y", data=yd)
+    except ImportError:
+        pass
+
+    try:
+        from scipy.io import netcdf_file
+
+        with netcdf_file(os.path.join(HERE, "iris.nc"), "w") as f:
+            f.createDimension("rows", X.shape[0])
+            f.createDimension("cols", X.shape[1])
+            v = f.createVariable("data", "d", ("rows", "cols"))
+            v[:] = X
+    except ImportError:
+        pass
+
+
+if __name__ == "__main__":
+    main()

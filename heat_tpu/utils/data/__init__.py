@@ -1,8 +1,9 @@
 """Data utilities (reference: heat/utils/data/)."""
 
-from . import matrixgallery, spherical
+from . import matrixgallery, mnist, spherical
 from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
 from .matrixgallery import parter
+from .mnist import MNISTDataset
 from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
 from .spherical import create_spherical_dataset
 from ...native import PrefetchPipeline
@@ -10,6 +11,8 @@ from ...native import PrefetchPipeline
 __all__ = [
     "DataLoader",
     "Dataset",
+    "MNISTDataset",
+    "mnist",
     "PartialH5Dataset",
     "PartialH5DataLoaderIter",
     "PrefetchPipeline",

@@ -1,0 +1,102 @@
+"""Bundled datasets, MNIST, and NetCDF I/O (reference: heat/datasets/,
+heat/utils/data/mnist.py, heat/core/tests/test_io.py)."""
+
+import gzip
+import os
+import struct
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.utils.data import DataLoader, MNISTDataset
+
+from .base import TestCase
+
+
+class TestBundledDatasets(TestCase):
+    def test_iris_csv(self):
+        x = ht.load(os.path.join(ht.datasets.path, "iris.csv"), sep=";", split=0)
+        self.assertEqual(tuple(x.shape), (150, 4))
+        y = ht.load(os.path.join(ht.datasets.path, "iris_labels.csv"), sep=";")
+        self.assertEqual(y.shape[0], 150)
+
+    def test_iris_h5(self):
+        x = ht.load(os.path.join(ht.datasets.path, "iris.h5"), dataset="data", split=0)
+        self.assertEqual(tuple(x.shape), (150, 4))
+
+    def test_iris_nc(self):
+        x = ht.load(os.path.join(ht.datasets.path, "iris.nc"), variable="data", split=0)
+        self.assertEqual(tuple(x.shape), (150, 4))
+
+    def test_diabetes_h5(self):
+        p = os.path.join(ht.datasets.path, "diabetes.h5")
+        x = ht.load(p, dataset="x", split=0)
+        y = ht.load(p, dataset="y", split=0)
+        self.assertEqual(tuple(x.shape), (442, 11))
+        self.assertEqual(x.shape[0], y.shape[0])
+
+    def test_train_test_files_consistent(self):
+        xtr = ht.load(os.path.join(ht.datasets.path, "iris_X_train.csv"), sep=";")
+        xte = ht.load(os.path.join(ht.datasets.path, "iris_X_test.csv"), sep=";")
+        self.assertEqual(xtr.shape[0] + xte.shape[0], 150)
+
+
+class TestNetCDF(TestCase):
+    def test_roundtrip(self):
+        if not ht.io.supports_netcdf():
+            self.skipTest("no NetCDF backend")
+        a = ht.random.randn(6, 3, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "t.nc")
+            ht.save(a, p, variable="data")
+            b = ht.load(p, variable="data", split=0, dtype=ht.float64)
+            np.testing.assert_allclose(b.numpy(), a.numpy(), rtol=1e-6)
+
+
+def _write_idx(path, arr):
+    ndim = arr.ndim
+    with gzip.open(path, "wb") if path.endswith(".gz") else open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x0800 | ndim))
+        f.write(struct.pack(f">{ndim}I", *arr.shape))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+class TestMNISTDataset(TestCase):
+    def test_idx_files(self):
+        """Real IDX ubyte files (gz and raw) are parsed, split=0."""
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 255, (32, 28, 28)).astype(np.uint8)
+        labels = rng.integers(0, 10, 32).astype(np.uint8)
+        with tempfile.TemporaryDirectory() as d:
+            _write_idx(os.path.join(d, "train-images-idx3-ubyte.gz"), images)
+            _write_idx(os.path.join(d, "train-labels-idx1-ubyte"), labels)
+            ds = MNISTDataset(d, train=True)
+            self.assertEqual(tuple(ds.htdata.shape), (32, 28, 28))
+            np.testing.assert_array_equal(ds.htdata.numpy(), images)
+            np.testing.assert_array_equal(ds.httargets.numpy(), labels)
+            self.assertEqual(ds.htdata.split, 0)
+
+    def test_missing_no_download_raises(self):
+        with tempfile.TemporaryDirectory() as d:
+            with self.assertRaises(FileNotFoundError):
+                MNISTDataset(d, download=False)
+
+    def test_synthetic_shuffle_and_loader(self):
+        with tempfile.TemporaryDirectory() as d:
+            ds = MNISTDataset(d, train=True, download=True)
+            n = len(ds)
+            before = ds.htdata.numpy().copy()
+            ds.Shuffle()
+            after = ds.htdata.numpy()
+            self.assertFalse(np.array_equal(before, after))
+            np.testing.assert_array_equal(
+                np.sort(before.sum((1, 2))), np.sort(after.sum((1, 2)))
+            )
+            dl = DataLoader(ds, batch_size=100, shuffle=False)
+            self.assertEqual(sum(b[0].shape[0] for b in dl), n)
+
+    def test_test_set_unsplit(self):
+        with tempfile.TemporaryDirectory() as d:
+            ds = MNISTDataset(d, train=False, test_set=True)
+            self.assertIsNone(ds.htdata.split)
