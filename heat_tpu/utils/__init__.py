@@ -1,5 +1,12 @@
 """Utilities (reference: heat/utils/)."""
 
-from . import data
+from . import checkpointing, data
+from .checkpointing import Checkpointer, load_checkpoint, save_checkpoint
 
-__all__ = ["data"]
+__all__ = [
+    "Checkpointer",
+    "checkpointing",
+    "data",
+    "load_checkpoint",
+    "save_checkpoint",
+]
