@@ -1,0 +1,44 @@
+# Continuous-benchmark clustering workloads (reference: benchmarks/cb/
+# cluster.py: kmeans/kmedians/kmedoids on spherical synthetic clusters).
+import heat_tpu as ht
+from heat_tpu.utils.monitor import monitor
+
+import config
+
+
+@monitor()
+def kmeans(data):
+    est = ht.cluster.KMeans(n_clusters=4, init="kmeans++")
+    est.fit(data)
+    return est.cluster_centers_.larray
+
+
+@monitor()
+def kmedians(data):
+    est = ht.cluster.KMedians(n_clusters=4, init="kmedians++")
+    est.fit(data)
+    return est.cluster_centers_.larray
+
+
+@monitor()
+def kmedoids(data):
+    est = ht.cluster.KMedoids(n_clusters=4, init="kmedoids++")
+    est.fit(data)
+    return est.cluster_centers_.larray
+
+
+def run():
+    data = ht.utils.data.spherical.create_spherical_dataset(
+        num_samples_cluster=config.CLUSTER_N,
+        radius=1.0,
+        offset=4.0,
+        dtype=ht.float32,
+        random_state=1,
+    )
+    kmeans(data)
+    kmedians(data)
+    kmedoids(data)
+
+
+if __name__ == "__main__":
+    run()

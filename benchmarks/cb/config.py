@@ -1,0 +1,13 @@
+# Problem sizes for the continuous-benchmark suite, scaled to the platform:
+# reference CI sizes on CPU (mpirun -n 4 equivalents), larger on TPU where
+# the MXU would otherwise be idle.
+import jax
+
+ON_TPU = jax.default_backend() == "tpu"
+
+MATMUL_N = 8192 if ON_TPU else 1500
+QR_N = 2048 if ON_TPU else 512
+TSQR_M, TSQR_N = (1_000_000, 128) if ON_TPU else (20_000, 64)
+CLUSTER_N = 250_000 if ON_TPU else 5_000
+RESHAPE_SIZES = [10_000, 20_000, 40_000] if ON_TPU else [1_000, 2_000]
+CONCAT_N = 1_000_000 if ON_TPU else 50_000

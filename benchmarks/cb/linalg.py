@@ -1,0 +1,55 @@
+# Continuous-benchmark linalg workloads (reference: benchmarks/cb/linalg.py:
+# matmul n=3000 split 0/1, qr n=2000 tiles 1-2 split 0/1, lanczos n=50 f64).
+import heat_tpu as ht
+from heat_tpu.utils.monitor import monitor
+
+import config
+
+
+@monitor()
+def matmul_split_0(n: int = config.MATMUL_N):
+    a = ht.random.random((n, n), split=0)
+    b = ht.random.random((n, n), split=0)
+    return (a @ b).larray
+
+
+@monitor()
+def matmul_split_1(n: int = config.MATMUL_N):
+    a = ht.random.random((n, n), split=1)
+    b = ht.random.random((n, n), split=1)
+    return (a @ b).larray
+
+
+@monitor()
+def qr(n: int = config.QR_N):
+    outs = []
+    for sp in range(2):
+        a = ht.random.random((n, n), split=sp)
+        outs.append(ht.linalg.qr(a).Q.larray)
+    return outs
+
+
+@monitor()
+def tsqr_tall_skinny(m: int = config.TSQR_M, n: int = config.TSQR_N):
+    a = ht.random.random((m, n), split=0)
+    return ht.linalg.qr(a).R.larray
+
+
+@monitor()
+def lanczos(n: int = 50):
+    A = ht.random.random((n, n), dtype=ht.float64, split=0)
+    B = A @ A.T
+    V, T = ht.lanczos(B, m=n)
+    return V.larray
+
+
+def run():
+    matmul_split_0()
+    matmul_split_1()
+    qr()
+    tsqr_tall_skinny()
+    lanczos()
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,18 @@
+# Continuous-benchmark entry (reference: benchmarks/cb/main.py, run by CI as
+# `mpirun -n 4 python benchmarks/cb/main.py` under perun).  Here: one process
+# driving the whole mesh; each workload prints a JSON measurement line.
+import json
+import sys
+
+import linalg
+import cluster
+import manipulations
+
+from heat_tpu.utils import monitor as _monitor
+
+if __name__ == "__main__":
+    linalg.run()
+    cluster.run()
+    manipulations.run()
+    print(json.dumps({"suite": "cb", "measurements": _monitor.measurements()}))
+    sys.exit(0)

@@ -1,0 +1,39 @@
+# Continuous-benchmark manipulation workloads (reference: benchmarks/cb/
+# manipulations.py: reshape with new_split; plus the concatenate/resplit
+# cases from the CI suite, SURVEY.md §6).
+import heat_tpu as ht
+from heat_tpu.utils.monitor import monitor
+
+import config
+
+
+@monitor()
+def reshape(sizes=config.RESHAPE_SIZES):
+    outs = []
+    for size in sizes:
+        st = ht.zeros((1000, size), split=1)
+        outs.append(ht.reshape(st, (st.size // 10, -1), new_split=1).larray)
+    return outs
+
+
+@monitor()
+def concatenate(n: int = config.CONCAT_N):
+    a = ht.random.random((n, 64), split=0)
+    b = ht.random.random((n, 64), split=0)
+    return ht.concatenate([a, b], axis=0).larray
+
+
+@monitor()
+def resplit(n: int = config.CONCAT_N):
+    a = ht.random.random((n, 64), split=0)
+    return ht.resplit(a, 1).larray
+
+
+def run():
+    reshape()
+    concatenate()
+    resplit()
+
+
+if __name__ == "__main__":
+    run()

@@ -1,6 +1,6 @@
 """Utilities (reference: heat/utils/)."""
 
-from . import checkpointing, data
+from . import checkpointing, data, monitor
 from .checkpointing import Checkpointer, load_checkpoint, save_checkpoint
 
 __all__ = [
@@ -8,5 +8,6 @@ __all__ = [
     "checkpointing",
     "data",
     "load_checkpoint",
+    "monitor",
     "save_checkpoint",
 ]

@@ -1,0 +1,100 @@
+"""Benchmark monitoring harness (SURVEY.md §5).
+
+The reference meters its CI benchmarks with the external ``perun`` energy/
+runtime monitor (`benchmarks/cb/cluster.py:2-5`, extras ``cb: perun>=0.2.0``).
+The TPU rebuild ships the equivalent in-tree: an ``@monitor()`` decorator that
+records wall time and device memory per call, can capture a ``jax.profiler``
+trace (Perfetto-viewable) when asked, and emits one JSON line per measurement
+— the same publish-to-dashboard shape as the reference's perun pipeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+__all__ = ["monitor", "measurements", "report", "reset", "profile_trace"]
+
+_MEASUREMENTS: List[Dict[str, Any]] = []
+
+
+def _device_memory() -> Optional[int]:
+    """Bytes in use on device 0, where the backend exposes it (TPU does;
+    CPU returns None)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return stats.get("bytes_in_use")
+
+
+def monitor(name: Optional[str] = None, emit: bool = True) -> Callable:
+    """Decorator: measure each call's wall time + device memory delta.
+
+    Mirrors perun's ``@monitor()`` usage in the reference's benchmark suite;
+    one JSON line per call goes to stderr (so stdout stays machine-parsable
+    for harnesses like bench.py) and into :func:`measurements`."""
+
+    def deco(fn: Callable) -> Callable:
+        label = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            mem0 = _device_memory()
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            # drain async dispatch so the clock covers the device work
+            try:
+                jax.block_until_ready(out)
+            except Exception:
+                pass
+            wall = time.perf_counter() - t0
+            mem1 = _device_memory()
+            entry = {"name": label, "wall_s": round(wall, 6)}
+            if mem1 is not None:
+                entry["device_bytes_in_use"] = mem1
+                if mem0 is not None:
+                    entry["device_bytes_delta"] = mem1 - mem0
+            _MEASUREMENTS.append(entry)
+            if emit:
+                print(json.dumps(entry), file=sys.stderr)
+            return out
+
+        return wrapper
+
+    return deco
+
+
+def measurements() -> List[Dict[str, Any]]:
+    """All measurements recorded since the last :func:`reset`."""
+    return list(_MEASUREMENTS)
+
+
+def report(file=None) -> None:
+    """Write every measurement as one JSON line (default: stderr)."""
+    out = file or sys.stderr
+    for entry in _MEASUREMENTS:
+        print(json.dumps(entry), file=out)
+
+
+def reset() -> None:
+    _MEASUREMENTS.clear()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a ``jax.profiler`` trace of the enclosed block into
+    ``log_dir`` (open with Perfetto / TensorBoard)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,46 @@
+"""Monitoring harness (SURVEY.md §5 — perun-equivalent in-tree)."""
+
+import io
+import json
+
+import heat_tpu as ht
+from heat_tpu.utils import monitor
+
+from .base import TestCase
+
+
+class TestMonitor(TestCase):
+    def setUp(self):
+        monitor.reset()
+
+    def test_decorator_records_wall_time(self):
+        @monitor.monitor(emit=False)
+        def work():
+            return (ht.random.randn(64, 64, split=0) @ ht.random.randn(64, 64)).larray
+
+        work()
+        work()
+        entries = monitor.measurements()
+        self.assertEqual(len(entries), 2)
+        self.assertEqual(entries[0]["name"], "work")
+        self.assertGreater(entries[0]["wall_s"], 0.0)
+
+    def test_report_json_lines(self):
+        @monitor.monitor(name="labelled", emit=False)
+        def work():
+            return None
+
+        work()
+        buf = io.StringIO()
+        monitor.report(file=buf)
+        lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+        self.assertEqual(lines[0]["name"], "labelled")
+
+    def test_reset(self):
+        @monitor.monitor(emit=False)
+        def work():
+            return None
+
+        work()
+        monitor.reset()
+        self.assertEqual(monitor.measurements(), [])
