@@ -62,4 +62,10 @@ def __getattr__(name):
         module = importlib.import_module(f".{name}", __name__)
         globals()[name] = module
         return module
+    if name in ("MPI_WORLD", "MPI_SELF"):
+        # lazily resolved default communicator, matching the reference's
+        # import-time globals (heat/core/communication.py:1909-1921)
+        from .core import communication
+
+        return getattr(communication, name)
     raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")

@@ -16,6 +16,16 @@ elif _os.environ["HEAT_TPU_X64"] == "1":
 
 from . import version
 from .version import __version__
+from . import communication
+from .communication import (
+    Communication,
+    MeshComm,
+    MPICommunication,
+    MPIRequest,
+    get_comm,
+    sanitize_comm,
+    use_comm,
+)
 from . import types
 from .types import *
 from . import devices

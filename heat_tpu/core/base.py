@@ -17,6 +17,7 @@ __all__ = [
     "RegressionMixin",
     "TransformMixin",
     "is_classifier",
+    "is_clusterer",
     "is_estimator",
     "is_regressor",
     "is_transformer",
@@ -146,6 +147,11 @@ def is_estimator(obj: Any) -> bool:
 
 def is_classifier(obj: Any) -> bool:
     return is_estimator(obj) and isinstance(obj, ClassificationMixin)
+
+
+def is_clusterer(obj: Any) -> bool:
+    """(reference: base.py:245)."""
+    return is_estimator(obj) and isinstance(obj, ClusteringMixin)
 
 
 def is_regressor(obj: Any) -> bool:

@@ -56,6 +56,7 @@ __all__ = [
     "stack",
     "swapaxes",
     "tile",
+    "mpi_topk",
     "topk",
     "unique",
     "vsplit",
@@ -416,6 +417,30 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         out[1].larray = i.larray
         return out
     return v, i
+
+
+def mpi_topk(a, b, dim: int = -1, largest: bool = True, sorted: bool = True):
+    """Combine two partial top-k results (reference: manipulations.py:3981, a
+    custom MPI reduce op over metadata-prefixed byte buffers).  XLA reduces
+    arbitrary computations so :func:`topk` never needs this; it survives as a
+    functional combiner for reference-API code: each operand is a
+    ``(values, indices)`` pair, the result is the top-k of their
+    concatenation along ``dim`` where ``k = values.shape[dim]``."""
+    (av, ai), (bv, bi) = a, b
+    k = av.shape[dim]
+    values = jnp.concatenate((jnp.asarray(av), jnp.asarray(bv)), axis=dim)
+    indices = jnp.concatenate((jnp.asarray(ai), jnp.asarray(bi)), axis=dim)
+    if dim not in (-1, values.ndim - 1):
+        values = jnp.moveaxis(values, dim, -1)
+        indices = jnp.moveaxis(indices, dim, -1)
+    top, sel = jax.lax.top_k(values if largest else -values, k)
+    if not largest:
+        top = -top
+    picked = jnp.take_along_axis(indices, sel, axis=-1)
+    if dim not in (-1, top.ndim - 1):
+        top = jnp.moveaxis(top, -1, dim)
+        picked = jnp.moveaxis(picked, -1, dim)
+    return top, picked
 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis=None):

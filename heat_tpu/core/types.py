@@ -37,6 +37,7 @@ __all__ = [
     "unsignedinteger",
     "floating",
     "complexfloating",
+    "complex",
     "int8",
     "byte",
     "int16",
@@ -261,6 +262,11 @@ class complex128(complexfloating):
 
 
 cdouble = complex128
+
+# reference: heat/core/types.py:367 names the abstract complex class
+# ``complex`` (shadowing the builtin); keep that spelling as an alias so
+# ``ht.types.complex`` resolves for users of the reference API.
+complex = complexfloating
 
 
 # ----------------------------------------------------------------- mappings

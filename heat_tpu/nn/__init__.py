@@ -8,8 +8,8 @@ through to ``flax.linen`` the same way; ``ht.nn.functional`` falls through to
 """
 
 import flax.linen as _linen
-import jax.nn as functional  # reference: heat/nn/functional.py falls through
 
+from . import functional  # reference: heat/nn/functional.py falls through
 from .data_parallel import DataParallel, DataParallelMultiGPU
 
 __all__ = ["DataParallel", "DataParallelMultiGPU", "functional"]

@@ -1,0 +1,32 @@
+"""Functional NN namespace (reference: heat/nn/functional.py).
+
+The reference resolves ``heat.nn.functional.X`` by falling through to
+``torch.nn.functional`` via a module ``__getattr__`` bound to
+``func_getattr`` (functional.py:9-20).  The TPU-native functional substrate
+is ``jax.nn`` (plus ``jax.numpy`` for the handful of names torch keeps in
+functional but jax keeps in numpy, e.g. ``max_pool`` equivalents live in
+``flax.linen``); the fall-through chain here is jax.nn → flax.linen.
+"""
+
+import flax.linen as _linen
+import jax.nn as _jnn
+
+__all__ = ["func_getattr"]
+
+
+def func_getattr(name):
+    """Resolve ``name`` against the functional substrate
+    (reference: functional.py:9 resolves against torch.nn.functional)."""
+    try:
+        return getattr(_jnn, name)
+    except AttributeError:
+        try:
+            return getattr(_linen, name)
+        except AttributeError:
+            raise AttributeError(
+                f"{name!r} is implemented neither in jax.nn nor flax.linen"
+            )
+
+
+def __getattr__(name):
+    return func_getattr(name)

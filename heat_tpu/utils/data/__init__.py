@@ -1,7 +1,7 @@
 """Data utilities (reference: heat/utils/data/)."""
 
-from . import matrixgallery, mnist, spherical
-from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
+from . import matrixgallery, mnist, spherical, _utils
+from .datatools import DataLoader, Dataset, dataset_irecv, dataset_ishuffle, dataset_shuffle
 from .matrixgallery import parter
 from .mnist import MNISTDataset
 from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
@@ -17,6 +17,7 @@ __all__ = [
     "PartialH5DataLoaderIter",
     "PrefetchPipeline",
     "create_spherical_dataset",
+    "dataset_irecv",
     "dataset_ishuffle",
     "dataset_shuffle",
     "matrixgallery",

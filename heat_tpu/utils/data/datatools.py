@@ -23,7 +23,7 @@ from ...core import random as ht_random
 from ...core import types
 from ...core.dndarray import DNDarray, _ensure_split
 
-__all__ = ["Dataset", "DataLoader", "dataset_shuffle", "dataset_ishuffle"]
+__all__ = ["Dataset", "DataLoader", "dataset_shuffle", "dataset_ishuffle", "dataset_irecv"]
 
 
 class Dataset:
@@ -113,3 +113,14 @@ def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
     """Non-blocking shuffle (reference: datatools.py:301). JAX dispatch is
     asynchronous already, so this is the same call."""
     dataset.shuffle()
+
+
+def dataset_irecv(dataset: Dataset) -> None:
+    """Complete a pending :func:`dataset_ishuffle` (reference:
+    datatools.py:343 waits on the Irecv handles posted by ishuffle).  JAX's
+    async dispatch plays the role of the Irecv ring, so completing means
+    draining the device queue for the shuffled arrays."""
+    import jax
+
+    for a in dataset.arrays:
+        jax.block_until_ready(a.larray)

@@ -20,7 +20,20 @@ import jax
 from ...core.dndarray import DNDarray
 from ...core import factories
 
-__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter"]
+__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
+
+
+def queue_thread(q: "queue.Queue") -> None:
+    """Worker loop that drains a queue of ``callable`` or ``(callable,
+    *args)`` work items (reference: partial_dataset.py:20, the loader/convert
+    thread body).  Run as a daemon thread target."""
+    while True:
+        items = q.get()
+        if isinstance(items, tuple):
+            items[0](*items[1:])
+        else:
+            items()
+        q.task_done()
 
 
 class PartialH5Dataset:

@@ -1,0 +1,131 @@
+"""Reference-API compat surface: names the reference exports that survive on
+TPU only as aliases or functional combiners (SURVEY.md §2.1/§2.2)."""
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.nn.functional import func_getattr
+from heat_tpu.utils.data import _utils
+from heat_tpu.utils.data.partial_dataset import queue_thread
+
+from .base import TestCase
+
+
+class TestCompatSurface(TestCase):
+    def test_estimator_predicates(self):
+        km = ht.cluster.KMeans()
+        self.assertTrue(ht.is_clusterer(km))
+        self.assertFalse(ht.is_classifier(km))
+        self.assertTrue(ht.is_estimator(km))
+
+    def test_abstract_complex_alias(self):
+        self.assertIs(ht.types.complex, ht.types.complexfloating)
+        self.assertTrue(issubclass(ht.complex64, ht.types.complex))
+        self.assertTrue(issubclass(ht.complex128, ht.types.complex))
+
+    def test_communication_aliases(self):
+        from heat_tpu.core import communication
+
+        self.assertIs(communication.MPICommunication, ht.MeshComm)
+        self.assertIsInstance(ht.MPI_WORLD, ht.MeshComm)
+        self.assertIsInstance(ht.MPI_SELF, ht.MeshComm)
+        # MPI_SELF mirrors MPI.COMM_SELF: a size-1 communicator
+        self.assertEqual(ht.MPI_SELF.size, 1)
+        self.assertGreater(ht.MPI_WORLD.size, 1)
+        self.assertIs(ht.get_comm(), ht.MPI_WORLD)
+        req = communication.MPIRequest(ht.arange(4, split=0).larray)
+        req.wait()
+        req.Wait()
+
+    def test_mpi_argmax_argmin_combiners(self):
+        lhs = np.array([3.0, 1.0, 0.0, 1.0])  # values [3,1], indices [0,1]
+        rhs = np.array([2.0, 5.0, 2.0, 3.0])  # values [2,5], indices [2,3]
+        out = np.asarray(ht.statistics.mpi_argmax(lhs, rhs))
+        np.testing.assert_array_equal(out, [3.0, 5.0, 0.0, 3.0])
+        out = np.asarray(ht.statistics.mpi_argmin(lhs, rhs))
+        np.testing.assert_array_equal(out, [2.0, 1.0, 2.0, 1.0])
+        # ties go to the lower index per element, regardless of operand order
+        tie_l = np.array([7.0, 4.0])
+        tie_r = np.array([7.0, 9.0])
+        for a, b in ((tie_l, tie_r), (tie_r, tie_l)):
+            out = np.asarray(ht.statistics.mpi_argmax(a, b))
+            np.testing.assert_array_equal(out, [7.0, 4.0])
+        # multi-element payloads with a tie in one slot only (the slot-0
+        # indices would pick the wrong operand under a whole-array swap)
+        lhs = np.array([5.0, 7.0, 10.0, 3.0])  # values [5,7], indices [10,3]
+        rhs = np.array([5.0, 7.0, 2.0, 8.0])  # values [5,7], indices [2,8]
+        out = np.asarray(ht.statistics.mpi_argmax(lhs, rhs))
+        np.testing.assert_array_equal(out, [5.0, 7.0, 2.0, 3.0])
+        # integer payloads keep their dtype (no float64 forcing — float64
+        # would truncate large indices to float32 when x64 is off, i.e. TPU)
+        out = ht.statistics.mpi_argmax(
+            np.array([1, 2, 30_000_001, 3]), np.array([0, 5, 7, 30_000_003])
+        )
+        np.testing.assert_array_equal(np.asarray(out), [1, 5, 30_000_001, 30_000_003])
+
+    def test_mpi_topk_combiner(self):
+        a = (np.array([[5.0, 3.0]]), np.array([[0, 1]]))
+        b = (np.array([[4.0, 6.0]]), np.array([[2, 3]]))
+        v, i = ht.manipulations.mpi_topk(a, b)
+        np.testing.assert_array_equal(np.asarray(v), [[6.0, 5.0]])
+        np.testing.assert_array_equal(np.asarray(i), [[3, 0]])
+        v, i = ht.manipulations.mpi_topk(a, b, largest=False)
+        np.testing.assert_array_equal(np.asarray(v), [[3.0, 4.0]])
+        np.testing.assert_array_equal(np.asarray(i), [[1, 2]])
+
+    def test_nn_functional_fallthrough(self):
+        self.assertIs(func_getattr("relu"), ht.nn.functional.relu)
+        self.assertIsNotNone(ht.nn.functional.softmax)
+        with self.assertRaises(AttributeError):
+            func_getattr("definitely_not_a_function")
+
+    def test_dataset_irecv_completes_ishuffle(self):
+        from heat_tpu.utils.data import Dataset, dataset_irecv, dataset_ishuffle
+
+        x = ht.arange(16, split=0)
+        ds = Dataset(x)
+        dataset_ishuffle(ds)
+        dataset_irecv(ds)
+        got = np.sort(np.asarray(ds.arrays[0].larray))
+        np.testing.assert_array_equal(got, np.arange(16))
+
+    def test_queue_thread_drains_work_items(self):
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue()
+        hits = []
+        t = threading.Thread(target=queue_thread, args=(q,), daemon=True)
+        t.start()
+        q.put((hits.append, 1))
+        q.put(lambda: hits.append(2))
+        q.join()
+        self.assertEqual(sorted(hits), [1, 2])
+
+    def test_dali_tfrecord2idx(self):
+        d = tempfile.mkdtemp()
+        for sub in ("t", "ti", "v", "vi"):
+            os.makedirs(os.path.join(d, sub))
+        with open(os.path.join(d, "t", "a.tfrecord"), "wb") as f:
+            for payload in (b"hello", b"world!!"):
+                f.write(
+                    struct.pack("<q", len(payload)) + b"\0" * 4 + payload + b"\0" * 4
+                )
+        _utils.dali_tfrecord2idx(
+            os.path.join(d, "t"),
+            os.path.join(d, "ti"),
+            os.path.join(d, "v"),
+            os.path.join(d, "vi"),
+        )
+        lines = open(os.path.join(d, "ti", "a.tfrecord")).read().splitlines()
+        self.assertEqual(lines, ["0 21", "21 23"])
+
+    def test_merge_imagenet_gates_or_rejects_bad_folder(self):
+        # RuntimeError when tensorflow/h5py are absent (the gate), otherwise
+        # the listdir of a nonexistent folder fails
+        with self.assertRaises((RuntimeError, FileNotFoundError, OSError)):
+            _utils.merge_files_imagenet_tfrecord("/nonexistent")
