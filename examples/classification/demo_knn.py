@@ -1,0 +1,57 @@
+"""k-nearest-neighbours demo with cross-validation on the bundled iris data
+(reference: examples/classification/demo_knn.py).
+
+Run: ``python examples/classification/demo_knn.py``.
+"""
+
+import heat_tpu as ht
+from heat_tpu import datasets
+from heat_tpu.classification import KNeighborsClassifier
+
+
+def calculate_accuracy(new_y, verification_y):
+    """Fraction of properly labelled samples (reference: demo_knn.py:28)."""
+    if new_y.gshape != verification_y.gshape:
+        raise ValueError(
+            f"Expecting results of same length, got {new_y.gshape}, {verification_y.gshape}"
+        )
+    count = ht.sum(ht.where(new_y == verification_y, 1, 0))
+    return count / new_y.gshape[0]
+
+
+def create_fold(dataset_x, dataset_y, size, seed=None):
+    """Hold out a random contiguous fold of ``size`` samples; return
+    (train_x, train_y, test_x, test_y)."""
+    import random
+
+    if seed is not None:
+        random.seed(seed)
+    n = dataset_x.shape[0]
+    start = random.randint(0, n - size - 1)
+    stop = start + size
+    fold_x = dataset_x[start:stop]
+    fold_y = dataset_y[start:stop]
+    rest_x = ht.concatenate((dataset_x[:start], dataset_x[stop:]), axis=0)
+    rest_y = ht.concatenate((dataset_y[:start], dataset_y[stop:]), axis=0)
+    return rest_x, rest_y, fold_x, fold_y
+
+
+def main():
+    X = ht.load_hdf5(f"{datasets.path}/iris.h5", dataset="data", split=0)
+    Y = ht.array([0] * 50 + [1] * 50 + [2] * 50, split=0)
+
+    accuracies = []
+    for i in range(5):
+        train_x, train_y, test_x, test_y = create_fold(X, Y, size=30, seed=i)
+        knn = KNeighborsClassifier(n_neighbors=5)
+        knn.fit(train_x, train_y)
+        pred = knn.predict(test_x)
+        acc = float(calculate_accuracy(pred, test_y).numpy())
+        accuracies.append(acc)
+        print(f"fold {i}: accuracy = {acc:.3f}")
+    print(f"mean accuracy over {len(accuracies)} folds: "
+          f"{sum(accuracies) / len(accuracies):.3f}")
+
+
+if __name__ == "__main__":
+    main()

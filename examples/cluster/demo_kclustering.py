@@ -1,0 +1,49 @@
+"""k-clustering demo (reference: examples/cluster/demo_kClustering.py).
+
+Fits KMeans / KMedians / KMedoids on four spherical clusters placed along
+the space diagonal and prints the recovered centers against the truth.
+Run: ``python examples/cluster/demo_kclustering.py``.
+"""
+
+import heat_tpu as ht
+from heat_tpu.utils.data import create_spherical_dataset
+
+
+def main():
+    seed = 1
+    reference = ht.array(
+        [[-8, -8, -8], [-4, -4, -4], [4, 4, 4], [8, 8, 8]], dtype=ht.float32
+    )
+
+    for n, radius, offset, dtype, scale in (
+        (20 * ht.MPI_WORLD.size, 1.0, 4.0, ht.float32, 1),
+        (100 * ht.MPI_WORLD.size, 1.0, 4.0, ht.float32, 1),
+        (20 * ht.MPI_WORLD.size, 10.0, 40.0, ht.int32, 10),
+    ):
+        data = create_spherical_dataset(
+            num_samples_cluster=n,
+            radius=radius,
+            offset=offset,
+            dtype=dtype,
+            random_state=seed,
+        )
+        clusterer = {
+            "kmeans": ht.cluster.KMeans(n_clusters=4, init="kmeans++"),
+            "kmedians": ht.cluster.KMedians(n_clusters=4, init="kmedians++"),
+            "kmedoids": ht.cluster.KMedoids(n_clusters=4, init="kmedoids++"),
+        }
+        print(
+            f"4 spherical clusters with radius {radius}, "
+            f"each {n} samples (dtype = {dtype.__name__})"
+        )
+        for name, c in clusterer.items():
+            c.fit(data)
+            print(
+                f"### Fitting with {name} ###\n"
+                f"Original sphere centers = {reference * scale}\n"
+                f"Fitted cluster centers = {c.cluster_centers_}"
+            )
+
+
+if __name__ == "__main__":
+    main()

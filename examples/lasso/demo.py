@@ -1,0 +1,52 @@
+"""LASSO path demo on the bundled diabetes dataset
+(reference: examples/lasso/demo.py).
+
+Computes the coordinate-descent LASSO path over a log-spaced range of
+regularization strengths and prints (or, with matplotlib, plots) the paths.
+Run: ``python examples/lasso/demo.py``.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import datasets
+from heat_tpu.regression import Lasso
+
+
+def main():
+    X = ht.load_hdf5(f"{datasets.path}/diabetes.h5", dataset="x", split=0)
+    y = ht.load_hdf5(f"{datasets.path}/diabetes.h5", dataset="y", split=0)
+
+    # normalize features (the reference does the same ahead of fit)
+    X = X / ht.sqrt(ht.mean(X**2, axis=0))
+
+    estimator = Lasso(max_iter=100)
+    lamda = np.logspace(0, 4, 10) / 10
+
+    theta_list = []
+    for la in lamda:
+        estimator.lam = float(la)
+        estimator.fit(X, y)
+        theta_list.append(estimator.theta.numpy().flatten())
+    theta_lasso = np.stack(theta_list).T[1:, :]
+
+    print("lambda grid:", np.round(lamda, 3))
+    print("coefficient paths (features x lambdas):")
+    print(np.round(theta_lasso, 4))
+
+    try:
+        from matplotlib import pyplot as plt
+
+        for row in theta_lasso:
+            plt.plot(lamda, row)
+        plt.xscale("log")
+        plt.xlabel("lambda")
+        plt.ylabel("coefficient")
+        plt.title("Lasso paths - heat_tpu implementation")
+        plt.show()
+    except ImportError:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -284,31 +284,32 @@ def var(x, axis=None, ddof: int = 0) -> DNDarray:
     )
 
 
+def _mpi_argreduce(a, b, cmp):
+    """Shared body of :func:`mpi_argmax`/:func:`mpi_argmin`: each operand is
+    a flat array whose first half holds values and second half indices; the
+    winner per element is chosen by ``cmp``, ties resolve to the lower
+    global index."""
+    lhs, rhs = jnp.asarray(a), jnp.asarray(b)
+    (lv, li), (rv, ri) = jnp.split(lhs, 2), jnp.split(rhs, 2)
+    take_l, take_r = cmp(lv, rv), cmp(rv, lv)
+    values = jnp.where(take_l, lv, rv)
+    indices = jnp.where(take_l, li, jnp.where(take_r, ri, jnp.minimum(li, ri)))
+    return jnp.concatenate((values, indices))
+
+
 def mpi_argmax(a, b, _=None):
     """Combine two packed ``(values, indices)`` argmax payloads
     (reference: statistics.py:1338, a custom MPI reduce op over raw byte
     buffers).  XLA reduces arbitrary computations, so :func:`argmax` never
-    needs this; it is kept as a functional combiner — each operand is a flat
-    array whose first half holds values and second half indices — for code
-    written against the reference API.  Ties resolve to the lower global
-    index, per element."""
-    lhs, rhs = jnp.asarray(a), jnp.asarray(b)
-    (lv, li), (rv, ri) = jnp.split(lhs, 2), jnp.split(rhs, 2)
-    take_l, take_r = lv > rv, lv < rv
-    values = jnp.where(take_l, lv, rv)
-    indices = jnp.where(take_l, li, jnp.where(take_r, ri, jnp.minimum(li, ri)))
-    return jnp.concatenate((values, indices))
+    needs this; it is kept as a functional combiner for code written against
+    the reference API."""
+    return _mpi_argreduce(a, b, jnp.greater)
 
 
 def mpi_argmin(a, b, _=None):
     """Combine two packed ``(values, indices)`` argmin payloads
     (reference: statistics.py:1374); see :func:`mpi_argmax`."""
-    lhs, rhs = jnp.asarray(a), jnp.asarray(b)
-    (lv, li), (rv, ri) = jnp.split(lhs, 2), jnp.split(rhs, 2)
-    take_l, take_r = lv < rv, lv > rv
-    values = jnp.where(take_l, lv, rv)
-    indices = jnp.where(take_l, li, jnp.where(take_r, ri, jnp.minimum(li, ri)))
-    return jnp.concatenate((values, indices))
+    return _mpi_argreduce(a, b, jnp.less)
 
 
 # method bindings (the reference binds these on DNDarray too)

@@ -197,21 +197,25 @@ class MeshComm(Communication):
 
 
 # ---------------------------------------------------------------------- world
+_world_comm: Optional[MeshComm] = None
 _default_comm: Optional[MeshComm] = None
 
 
 def world() -> MeshComm:
-    """The default communication context over all devices (reference:
-    MPI_WORLD, communication.py:1909)."""
-    global _default_comm
-    if _default_comm is None:
-        _default_comm = MeshComm()
-    return _default_comm
+    """The all-device communication context (reference: MPI_WORLD,
+    communication.py:1909).  Fixed once created: narrowing the *default*
+    context via :func:`use_comm` never changes what ``world()`` returns,
+    just as MPI.COMM_WORLD is unaffected by the reference's ``use_comm``."""
+    global _world_comm
+    if _world_comm is None:
+        _world_comm = MeshComm()
+    return _world_comm
 
 
 def get_comm() -> MeshComm:
-    """Return the current default context (reference: communication.py:1927)."""
-    return world()
+    """Return the current default context (reference: communication.py:1927).
+    Starts as :func:`world`; redirected by :func:`use_comm`."""
+    return _default_comm if _default_comm is not None else world()
 
 
 def use_comm(comm: Optional[MeshComm] = None) -> None:

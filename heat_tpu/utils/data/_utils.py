@@ -31,17 +31,21 @@ def dali_tfrecord2idx(train_dir, train_idx_dir, val_dir, val_idx_dir):
             src = os.path.join(src_dir, name)
             if not os.path.isfile(src):
                 continue
+            fsize = os.path.getsize(src)
             with open(src, "rb") as f, open(os.path.join(idx_dir, name), "w") as idx:
                 while True:
                     start = f.tell()
                     header = f.read(8)
                     if len(header) < 8:
                         break
-                    (length,) = struct.unpack("<q", header)
-                    f.seek(4, os.SEEK_CUR)  # length crc
-                    f.seek(length, os.SEEK_CUR)  # payload
-                    f.seek(4, os.SEEK_CUR)  # payload crc
-                    idx.write(f"{start} {f.tell() - start}\n")
+                    (length,) = struct.unpack("<Q", header)
+                    end = start + 8 + 4 + length + 4  # header, crc, payload, crc
+                    if end > fsize:
+                        # corrupt length or truncated final record: stop
+                        # rather than index bytes that do not exist
+                        break
+                    f.seek(end)
+                    idx.write(f"{start} {end - start}\n")
 
 
 def merge_files_imagenet_tfrecord(folder_name, output_folder=None):

@@ -1,0 +1,15 @@
+"""Test script for the heat_tpu installation (reference: scripts/heat_test.py).
+
+The reference validates the MPI + Heat install under ``mpirun``; here one
+process owns the whole mesh, so the script validates the JAX backend, the
+device mesh, and the split distribution instead.
+"""
+
+import heat_tpu as ht
+
+x = ht.arange(10, split=0)
+print("x is distributed: ", x.is_distributed())
+print("mesh: ", x.comm.mesh)
+print("Global DNDarray x: ", x)
+for i, shard in enumerate(x.lshards()):
+    print("Local shard on device", i, ":", shard)

@@ -37,6 +37,18 @@ class TestCompatSurface(TestCase):
         self.assertEqual(ht.MPI_SELF.size, 1)
         self.assertGreater(ht.MPI_WORLD.size, 1)
         self.assertIs(ht.get_comm(), ht.MPI_WORLD)
+        # narrowing the default communicator must not change MPI_WORLD
+        # (MPI.COMM_WORLD is fixed in the reference)
+        from heat_tpu.parallel.mesh import local_mesh
+
+        narrow = local_mesh(1)
+        ht.use_comm(narrow)
+        try:
+            self.assertIs(ht.get_comm(), narrow)
+            self.assertGreater(ht.MPI_WORLD.size, 1)
+        finally:
+            ht.use_comm(None)
+        self.assertIs(ht.get_comm(), ht.MPI_WORLD)
         req = communication.MPIRequest(ht.arange(4, split=0).larray)
         req.wait()
         req.Wait()
@@ -123,6 +135,18 @@ class TestCompatSurface(TestCase):
         )
         lines = open(os.path.join(d, "ti", "a.tfrecord")).read().splitlines()
         self.assertEqual(lines, ["0 21", "21 23"])
+        # truncated / corrupt record: no index line past EOF, no infinite loop
+        with open(os.path.join(d, "t", "bad.tfrecord"), "wb") as f:
+            f.write(struct.pack("<q", 3) + b"\0" * 4 + b"abc" + b"\0" * 4)
+            f.write(struct.pack("<Q", 2**63 + 5))  # corrupt length, MSB set
+        _utils.dali_tfrecord2idx(
+            os.path.join(d, "t"),
+            os.path.join(d, "ti"),
+            os.path.join(d, "v"),
+            os.path.join(d, "vi"),
+        )
+        lines = open(os.path.join(d, "ti", "bad.tfrecord")).read().splitlines()
+        self.assertEqual(lines, ["0 19"])
 
     def test_merge_imagenet_gates_or_rejects_bad_folder(self):
         # RuntimeError when tensorflow/h5py are absent (the gate), otherwise
