@@ -8,16 +8,82 @@ one XLA op (the sampled rows end up replicated, exactly like the Bcast)."""
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Optional, Union
 
+import jax
 import jax.numpy as jnp
 
 from ..core import random as ht_random
 from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray, _ensure_split
 from ..core import types
+from ..ops.cdist import cdist as ops_cdist
 
 __all__ = ["_KCluster"]
+
+
+def _masked_medians(x, labels, k: int, fallback):
+    """Per-cluster, per-feature median of the rows assigned to each cluster.
+
+    The naive masked formulation (reference: kmedians.py:57 builds a
+    per-cluster selection) would materialize an ``(n, k, f)`` tensor for a
+    NaN-median — 20 GB at 1e7x64x8.  Instead: one ``(n, f)`` sort per cluster
+    (non-members pushed to +inf sort to the end), then the two middle rows of
+    the member prefix are picked by dynamic index.  Empty clusters fall back
+    to ``fallback[j]``."""
+
+    def body(j, meds):
+        mask = labels == j
+        cnt = jnp.sum(mask)
+        svals = jnp.sort(jnp.where(mask[:, None], x, jnp.inf), axis=0)
+        lo = jnp.maximum((cnt - 1) // 2, 0)
+        hi = cnt // 2
+        med = (
+            jax.lax.dynamic_index_in_dim(svals, lo, 0, keepdims=False)
+            + jax.lax.dynamic_index_in_dim(svals, hi, 0, keepdims=False)
+        ) * 0.5
+        return meds.at[j].set(jnp.where(cnt > 0, med, fallback[j]))
+
+    return jax.lax.fori_loop(0, k, body, jnp.zeros((k, x.shape[1]), x.dtype))
+
+
+def _l1_assign(x, centers):
+    """Labels by Manhattan distance; the broadcast |x-c| fuses into the
+    reduction (no (n, k, f) buffer)."""
+    d1 = jnp.sum(jnp.abs(x[:, None, :] - centers[None, :, :]), axis=-1)
+    return jnp.argmin(d1, axis=1)
+
+
+@partial(jax.jit, static_argnames=("k", "snap_to_sample"))
+def _median_loop(x, centers, k: int, max_iter, tol, snap_to_sample: bool):
+    """On-device KMedians/KMedoids iteration loop (one XLA program; see
+    kmeans._lloyd_loop for why host round-trips per iteration are fatal
+    through a remote TPU tunnel).
+
+    ``snap_to_sample=False``: KMedians — centers move to per-cluster medians.
+    ``snap_to_sample=True``: KMedoids — the median is snapped to the nearest
+    actual sample (reference: kmedoids.py:56 "closest sample to the median").
+    """
+
+    def cond(state):
+        _, shift, it = state
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(state):
+        centers, _, it = state
+        labels = _l1_assign(x, centers)
+        new = _masked_medians(x, labels, k, centers)
+        if snap_to_sample:
+            counts = jnp.sum(labels[:, None] == jnp.arange(k)[None, :], axis=0)
+            d2 = ops_cdist(x, new, sqrt=False)
+            idx = jnp.argmin(d2, axis=0)
+            new = jnp.where(counts[:, None] > 0, x[idx], centers)
+        shift = jnp.sum((new - centers) ** 2)
+        return new, shift, it + 1
+
+    init = (centers, jnp.array(jnp.inf, x.dtype), 0)
+    return jax.lax.while_loop(cond, body, init)
 
 
 class _KCluster(ClusteringMixin, BaseEstimator):
@@ -133,6 +199,31 @@ class _KCluster(ClusteringMixin, BaseEstimator):
 
     def fit(self, x: DNDarray):
         raise NotImplementedError()
+
+    def _fit_median_loop(self, x: DNDarray, snap_to_sample: bool):
+        """Shared KMedians/KMedoids fit body: initialize, run the on-device
+        :func:`_median_loop`, rebuild center/label metadata."""
+        from ..core import sanitation
+
+        sanitation.sanitize_in(x)
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2-D, but was {x.ndim}-D")
+        self._initialize_cluster_centers(x)
+        arr = x.larray
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(jnp.float32)
+        centers = self._cluster_centers.larray.astype(arr.dtype)
+        centers, _, n_iter = _median_loop(
+            arr, centers, self.n_clusters, self.max_iter, self.tol,
+            snap_to_sample=snap_to_sample,
+        )
+        self._n_iter = int(n_iter)
+        self._cluster_centers = DNDarray(
+            centers, tuple(centers.shape),
+            types.canonical_heat_type(centers.dtype), None, x.device, x.comm,
+        )
+        self._labels = self._assign_to_cluster(x)
+        return self
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Closest-cluster index for each sample (reference: _kcluster.py)."""

@@ -17,10 +17,33 @@ import jax.numpy as jnp
 
 from ..core.dndarray import DNDarray
 from ..core import types
+from ..ops.cdist import cdist as ops_cdist
 from ..spatial import distance
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_loop(x, centers, k: int, max_iter, tol):
+    """Run Lloyd iterations until ``shift² <= tol`` or ``max_iter``, entirely
+    on-device (``lax.while_loop``).  The reference reads the convergence
+    scalar back to the host every iteration (kmeans.py:102-139, ``.item()``
+    broadcast); through a remote TPU tunnel one readback costs ~100× an
+    iteration's compute, so the whole loop is a single XLA program and the
+    host sees only the final (centers, shift, inertia, n_iter)."""
+
+    def cond(state):
+        _, shift, _, it = state
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(state):
+        centers, _, _, it = state
+        new_centers, shift, inertia = _lloyd_step(x, centers, k)
+        return new_centers, shift, inertia, it + 1
+
+    init = (centers, jnp.array(jnp.inf, x.dtype), jnp.array(0.0, x.dtype), 0)
+    return jax.lax.while_loop(cond, body, init)
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -30,17 +53,16 @@ def _lloyd_step(x, centers, k: int):
     With ``x`` row-sharded and ``centers`` replicated, XLA compiles this to
     local MXU matmuls plus a single psum of the (k, f) sums and (k,) counts.
     """
-    x2 = jnp.sum(x * x, axis=1)[:, None]
-    c2 = jnp.sum(centers * centers, axis=1)[None, :]
-    cross = jnp.matmul(x, centers.T)
-    d2 = x2 + c2 - 2.0 * cross
+    d2 = ops_cdist(x, centers, sqrt=False)
     labels = jnp.argmin(d2, axis=1)
     onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(x.dtype)
     counts = jnp.sum(onehot, axis=0)
     sums = jnp.matmul(onehot.T, x)
     new_centers = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1)[:, None], centers)
     shift = jnp.sum((new_centers - centers) ** 2)
-    inertia = jnp.sum(jnp.take_along_axis(d2, labels[:, None], axis=1))
+    # distance to the assigned (= nearest) centroid is the row minimum; a
+    # take_along_axis gather here costs ~20x the rest of the step on TPU
+    inertia = jnp.sum(jnp.min(d2, axis=1))
     return new_centers, shift, inertia
 
 
@@ -101,12 +123,10 @@ class KMeans(_KCluster):
             arr = arr.astype(jnp.float32)
         centers = self._cluster_centers.larray.astype(arr.dtype)
 
-        self._n_iter = 0
-        for _ in range(self.max_iter):
-            centers, shift, inertia = _lloyd_step(arr, centers, self.n_clusters)
-            self._n_iter += 1
-            if float(shift) <= self.tol:
-                break
+        centers, _, inertia, n_iter = _lloyd_loop(
+            arr, centers, self.n_clusters, self.max_iter, self.tol
+        )
+        self._n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray(
             centers, tuple(centers.shape), types.canonical_heat_type(centers.dtype),

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from ..core.dndarray import DNDarray
 from ..core import types
 from ..spatial import distance
+from . import _kcluster
 from ._kcluster import _KCluster
 
 __all__ = ["KMedians"]
@@ -41,18 +42,14 @@ class KMedians(_KCluster):
         )
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
-        """Per-cluster masked median (reference: kmedians.py:57)."""
+        """Per-cluster masked median (reference: kmedians.py:57). Exposed for
+        API parity; ``fit`` uses the fused on-device loop."""
         labels = matching_centroids.larray.reshape(-1)
         arr = x.larray
         if not jnp.issubdtype(arr.dtype, jnp.floating):
             arr = arr.astype(jnp.float32)
         old = self._cluster_centers.larray.astype(arr.dtype)
-        # (n, k, f) NaN-masked view; nanmedian reduces the sample axis
-        mask = labels[:, None] == jnp.arange(self.n_clusters)[None, :]
-        masked = jnp.where(mask[:, :, None], arr[:, None, :], jnp.nan)
-        med = jnp.nanmedian(masked, axis=0)
-        counts = jnp.sum(mask, axis=0)
-        new = jnp.where(counts[:, None] > 0, med, old)
+        new = _kcluster._masked_medians(arr, labels, self.n_clusters, old)
         return DNDarray(
             new, tuple(new.shape), types.canonical_heat_type(new.dtype),
             None, x.device, x.comm,
@@ -60,21 +57,5 @@ class KMedians(_KCluster):
 
     def fit(self, x: DNDarray) -> "KMedians":
         """Iterate assignment + median update until the centroid shift is
-        below tol (reference: kmedians.py fit)."""
-        from ..core import sanitation
-
-        sanitation.sanitize_in(x)
-        if x.ndim != 2:
-            raise ValueError(f"input needs to be 2-D, but was {x.ndim}-D")
-        self._initialize_cluster_centers(x)
-        self._n_iter = 0
-        for _ in range(self.max_iter):
-            labels = self._assign_to_cluster(x)
-            new_centers = self._update_centroids(x, labels)
-            shift = float(jnp.sum((new_centers.larray - self._cluster_centers.larray) ** 2))
-            self._cluster_centers = new_centers
-            self._n_iter += 1
-            if shift <= self.tol:
-                break
-        self._labels = self._assign_to_cluster(x)
-        return self
+        below tol, in one on-device XLA loop (reference: kmedians.py fit)."""
+        return self._fit_median_loop(x, snap_to_sample=False)

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from ..core.dndarray import DNDarray
 from ..core import types
 from ..spatial import distance
+from . import _kcluster
 from ._kcluster import _KCluster
 
 __all__ = ["KMedoids"]
@@ -31,7 +32,9 @@ class KMedoids(_KCluster):
         if isinstance(init, str) and init == "kmedoids++":
             init = "probability_based"
         super().__init__(
-            metric=lambda x, y: distance.cdist(x, y),
+            # the reference's KMedoids assigns by Manhattan distance
+            # (kmedoids.py:48), matching the L1 assignment in _median_loop
+            metric=lambda x, y: distance.manhattan(x, y, expand=True),
             n_clusters=n_clusters,
             init=init,
             max_iter=max_iter,
@@ -47,39 +50,20 @@ class KMedoids(_KCluster):
         if not jnp.issubdtype(arr.dtype, jnp.floating):
             arr = arr.astype(jnp.float32)
         old = self._cluster_centers.larray.astype(arr.dtype)
-        mask = labels[:, None] == jnp.arange(self.n_clusters)[None, :]
-        masked = jnp.where(mask[:, :, None], arr[:, None, :], jnp.nan)
-        med = jnp.nanmedian(masked, axis=0)  # (k, f)
-        counts = jnp.sum(mask, axis=0)
-        med = jnp.where(counts[:, None] > 0, med, old)
+        med = _kcluster._masked_medians(arr, labels, self.n_clusters, old)
+        counts = jnp.sum(
+            labels[:, None] == jnp.arange(self.n_clusters)[None, :], axis=0
+        )
         # snap each median to the closest actual data point (the medoid)
-        x2 = jnp.sum(arr * arr, axis=1)[:, None]
-        m2 = jnp.sum(med * med, axis=1)[None, :]
-        d2 = x2 + m2 - 2.0 * jnp.matmul(arr, med.T)  # (n, k)
+        d2 = _kcluster.ops_cdist(arr, med, sqrt=False)  # (n, k)
         idx = jnp.argmin(d2, axis=0)  # (k,)
-        new = arr[idx]
-        new = jnp.where(counts[:, None] > 0, new, old)
+        new = jnp.where(counts[:, None] > 0, arr[idx], old)
         return DNDarray(
             new, tuple(new.shape), types.canonical_heat_type(new.dtype),
             None, x.device, x.comm,
         )
 
     def fit(self, x: DNDarray) -> "KMedoids":
-        """Iterate until the medoids stop changing (reference: kmedoids.py fit)."""
-        from ..core import sanitation
-
-        sanitation.sanitize_in(x)
-        if x.ndim != 2:
-            raise ValueError(f"input needs to be 2-D, but was {x.ndim}-D")
-        self._initialize_cluster_centers(x)
-        self._n_iter = 0
-        for _ in range(self.max_iter):
-            labels = self._assign_to_cluster(x)
-            new_centers = self._update_centroids(x, labels)
-            unchanged = bool(jnp.all(new_centers.larray == self._cluster_centers.larray))
-            self._cluster_centers = new_centers
-            self._n_iter += 1
-            if unchanged:
-                break
-        self._labels = self._assign_to_cluster(x)
-        return self
+        """Iterate until the medoids stop changing, in one on-device XLA loop
+        (reference: kmedoids.py fit)."""
+        return self._fit_median_loop(x, snap_to_sample=True)

@@ -14,6 +14,7 @@ Dispatch mirrors ops.matmul: Pallas on TPU, jnp expansion otherwise,
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -91,6 +92,14 @@ def cdist(x: jax.Array, y: jax.Array, *, sqrt: bool = True) -> jax.Array:
     if x.ndim != 2 or y.ndim != 2:
         raise ValueError("cdist expects 2-D inputs")
     mode = _mode()
+    # the Pallas kernel pads m→8 and n→128 lane multiples; for skinny
+    # operands (e.g. KMeans' n=k=8 centroids) the padded (m, 128) output
+    # would dominate HBM (10 GB at m=2e7), so XLA's fused expansion wins.
+    # An explicit HEAT_TPU_PALLAS=interpret/tpu override still reaches the
+    # kernel (the kernel's own tests depend on that).
+    forced = os.environ.get("HEAT_TPU_PALLAS", "") in ("interpret", "tpu")
+    if not forced and (x.shape[0] < 8 or y.shape[0] < 128):
+        mode = "off"
     if mode == "off":
         x32 = x.astype(jnp.float32)
         y32 = y.astype(jnp.float32)
