@@ -1,0 +1,131 @@
+"""NumPy-oracle parity sweep across the split matrix.
+
+The reference's test convention (SURVEY.md §4): every op is exercised for
+split=None/0/1 with odd shapes so chunk remainders and empty shards are hit,
+and the global result is compared against NumPy.  This file is the broad
+sweep version of that convention: one oracle harness, many ops.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+SPLITS = (None, 0, 1)
+
+
+class TestNumpyParity(TestCase):
+    @classmethod
+    def setUpClass(cls):
+        super().setUpClass()
+        rng = np.random.default_rng(0)
+        cls.A = rng.standard_normal((13, 7)).astype(np.float32)
+        cls.B = rng.standard_normal((13, 7)).astype(np.float32)
+        cls.M = rng.standard_normal((9, 9)).astype(np.float64)
+        cls.V = rng.standard_normal(29).astype(np.float32)
+
+    def _check(self, got, want, rtol=1e-5, atol=1e-6):
+        got = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+        np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+
+    def test_getitem_matrix(self):
+        A = self.A
+        for split in SPLITS:
+            a = ht.array(A, split=split)
+            self._check(a[3:11:2, 1:5], A[3:11:2, 1:5])
+            self._check(a[-4:, ::-1], A[-4:, ::-1])
+            self._check(a[5], A[5])
+            self._check(a[[0, 5, 12], [1, 2, 3]], A[[0, 5, 12], [1, 2, 3]])
+            self._check(a[A[:, 0] > 0], A[A[:, 0] > 0])
+            self._check(a[..., 2], A[..., 2])
+            self._check(a[:, None, :], A[:, None, :])
+
+    def test_setitem_matrix(self):
+        A = self.A
+        for split in SPLITS:
+            b = ht.array(A, split=split)
+            B = A.copy()
+            b[2:5, 3] = 9.0
+            B[2:5, 3] = 9.0
+            self._check(b, B)
+            b = ht.array(A, split=split)
+            B = A.copy()
+            b[[1, 3], :] = ht.ones((2, 7))
+            B[[1, 3], :] = 1
+            self._check(b, B)
+
+    def test_sort_order_stats(self):
+        A = self.A
+        for split in SPLITS:
+            a = ht.array(A, split=split)
+            values, indices = ht.sort(a, axis=0)
+            self._check(values, np.sort(A, axis=0))
+            self._check(indices, np.argsort(A, axis=0, kind="stable"))
+            self._check(ht.median(a), np.median(A))
+            self._check(ht.percentile(a, 35.0), np.percentile(A, 35.0))
+            ints = (A * 4).astype(np.int32) % 5
+            self._check(
+                ht.unique(ht.array(ints, split=split), sorted=True), np.unique(ints)
+            )
+
+    def test_reductions_scans(self):
+        A = self.A
+        for split in SPLITS:
+            a = ht.array(A, split=split)
+            self._check(a.argmax(axis=0), A.argmax(axis=0))
+            self._check(a.argmax(), A.argmax())
+            self._check(ht.cumsum(a, 0), np.cumsum(A, 0))
+            self._check(ht.diff(a, axis=0), np.diff(A, axis=0))
+            self._check(ht.var(a, axis=0), A.var(axis=0))
+            self._check(ht.std(a, axis=1), A.std(axis=1))
+
+    def test_manipulations_matrix(self):
+        A = self.A
+        for split in SPLITS:
+            a = ht.array(A, split=split)
+            self._check(ht.roll(a, 3, axis=0), np.roll(A, 3, axis=0))
+            self._check(ht.pad(a, ((1, 2), (0, 1))), np.pad(A, ((1, 2), (0, 1))))
+            self._check(ht.flip(a, 0), np.flip(A, 0))
+            self._check(ht.reshape(a, (7, 13)), A.reshape(7, 13))
+            self._check(ht.where(a > 0, a, -a), np.where(A > 0, A, -A))
+
+    def test_linalg_matrix(self):
+        M = self.M
+        for split in SPLITS:
+            m = ht.array(M, split=split)
+            self._check(ht.linalg.det(m), np.linalg.det(M), rtol=1e-3)
+            self._check(ht.linalg.inv(m), np.linalg.inv(M), rtol=1e-3)
+            self._check(ht.linalg.trace(m), np.trace(M))
+            self._check(ht.linalg.norm(m), np.linalg.norm(M))
+            self._check(ht.tril(m), np.tril(M))
+
+    def test_binary_split_mix(self):
+        A, B = self.A, self.B
+        for s1 in SPLITS:
+            for s2 in SPLITS:
+                x, y = ht.array(A, split=s1), ht.array(B, split=s2)
+                self._check(x + y, A + B)
+                self._check(
+                    ht.matmul(x, ht.array(B.T, split=s2)), A @ B.T, rtol=1e-3
+                )
+
+    def test_broadcast_across_split(self):
+        A, B = self.A, self.B
+        self._check(ht.array(A, split=0) + ht.array(B[0:1], split=None), A + B[0:1])
+        self._check(
+            ht.array(A, split=1) * ht.array(B[:, :1], split=0), A * B[:, :1]
+        )
+        self._check(ht.array(A, split=0) ** 2, A**2)
+
+    def test_outer_skew(self):
+        V = self.V
+        self._check(
+            ht.linalg.outer(ht.array(V[:13], split=0), ht.array(V[:7], split=0)),
+            np.outer(V[:13], V[:7]),
+        )
+        # skew with the reference's default bias correction
+        n = V.size
+        biased = ((V - V.mean()) ** 3).mean() / V.std() ** 3
+        expected = biased * np.sqrt(n * (n - 1)) / (n - 2)
+        self._check(ht.statistics.skew(ht.array(V, split=0)), expected, rtol=1e-4)
