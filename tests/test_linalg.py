@@ -94,6 +94,31 @@ class TestQR(TestCase):
             q, r = ht.linalg.qr(x)
             np.testing.assert_allclose(q.numpy() @ r.numpy(), data, rtol=1e-8, atol=1e-8)
 
+    def test_cholesky_qr2_tall_path(self):
+        """Replicated tall-skinny inputs take the CholeskyQR2 MXU path; it
+        must deliver working-precision orthogonality."""
+        rng = np.random.default_rng(141)
+        data = rng.standard_normal((512, 16)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(data))
+        qn, rn = q.numpy(), r.numpy()
+        np.testing.assert_allclose(qn @ rn, data, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(qn.T @ qn, np.eye(16), atol=1e-4)
+        np.testing.assert_allclose(rn, np.triu(rn), atol=1e-5)
+        self.assertTrue((np.diag(rn) > 0).all())
+
+    def test_qr_ill_conditioned_falls_back(self):
+        """cond(A)² overflows the float32 Gram matrix; qr must detect the
+        failed Cholesky and still return an accurate factorization."""
+        rng = np.random.default_rng(143)
+        u, _ = np.linalg.qr(rng.standard_normal((256, 8)))
+        v, _ = np.linalg.qr(rng.standard_normal((8, 8)))
+        s = np.logspace(0, -7, 8)  # cond 1e7
+        data = (u * s) @ v.T
+        q, r = ht.linalg.qr(ht.array(data.astype(np.float32)))
+        qn, rn = q.numpy(), r.numpy()
+        np.testing.assert_allclose(qn @ rn, data, rtol=1e-3, atol=1e-6)
+        np.testing.assert_allclose(qn.T @ qn, np.eye(8), atol=1e-3)
+
     def test_qr_matches_across_splits(self):
         """Same factorization regardless of distribution (sign-normalized)."""
         rng = np.random.default_rng(139)
