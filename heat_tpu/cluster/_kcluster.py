@@ -48,11 +48,49 @@ def _masked_medians(x, labels, k: int, fallback):
     return jax.lax.fori_loop(0, k, body, jnp.zeros((k, x.shape[1]), x.dtype))
 
 
-def _l1_assign(x, centers):
-    """Labels by Manhattan distance; the broadcast |x-c| fuses into the
+def _l1_dist(x, centers):
+    """(n, k) Manhattan distances; the broadcast |x-c| fuses into the
     reduction (no (n, k, f) buffer)."""
-    d1 = jnp.sum(jnp.abs(x[:, None, :] - centers[None, :, :]), axis=-1)
-    return jnp.argmin(d1, axis=1)
+    return jnp.sum(jnp.abs(x[:, None, :] - centers[None, :, :]), axis=-1)
+
+
+def _l1_assign(x, centers):
+    """Labels by Manhattan distance."""
+    return jnp.argmin(_l1_dist(x, centers), axis=1)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _kmeanspp_init(arr, us, k: int):
+    """Distance-weighted (kmeans++) seeding, fused on-device (reference:
+    _kcluster.py:141 draws one sample per round with a Bcast; through a
+    remote TPU tunnel each round's ``.item()`` readback costs ~100x the
+    distance computation, so all k rounds run in one XLA program fed by a
+    single batch of uniforms).
+
+    Matches the reference's weighting — Euclidean distance to the nearest
+    chosen center, for every estimator (the reference's probability_based
+    branch always uses ``spatial.cdist``, _kcluster.py:161) — carried as a
+    running min so each round costs one (n, 1) distance column rather than
+    an (n, k) recomputation.  Divergence from the reference, on purpose: the
+    reference mins over all k centroid slots including the still-zero
+    placeholders, so distance-to-origin leaks into its weights; here
+    unchosen slots do not participate."""
+    n, _ = arr.shape
+    first = jnp.minimum((us[0] * n).astype(jnp.int32), n - 1)
+    c0 = jax.lax.dynamic_index_in_dim(arr, first, 0, keepdims=False)
+    centers = jnp.zeros((k, arr.shape[1]), arr.dtype).at[0].set(c0)
+    d = ops_cdist(arr, c0[None, :], sqrt=True)[:, 0]
+
+    def body(j, carry):
+        centers, d = carry
+        cum = jnp.cumsum(d / jnp.sum(d))
+        nxt = jnp.minimum(jnp.searchsorted(cum, us[j]), n - 1)
+        cj = jax.lax.dynamic_index_in_dim(arr, nxt, 0, keepdims=False)
+        d = jnp.minimum(d, ops_cdist(arr, cj[None, :], sqrt=True)[:, 0])
+        return centers.at[j].set(cj), d
+
+    centers, _ = jax.lax.fori_loop(1, k, body, (centers, d))
+    return centers
 
 
 @partial(jax.jit, static_argnames=("k", "snap_to_sample"))
@@ -143,35 +181,20 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             self._cluster_centers = self.init.resplit(None)
             return
 
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(jnp.float32)
         if isinstance(self.init, str) and self.init == "random":
             # one sample per stratum [i*n/k, (i+1)*n/k) — the reference's
-            # equal-distribution draw (_kcluster.py:101-123)
-            idx = []
-            for i in range(k):
-                lo = n // k * i
-                hi = n // k * (i + 1)
-                idx.append(int(ht_random.randint(lo, max(hi, lo + 1)).item()))
-            centroids = arr[jnp.asarray(idx)]
+            # equal-distribution draw (_kcluster.py:101-123); one batched
+            # uniform draw, indices never leave the device
+            us = ht_random.rand(k).larray.astype(arr.dtype)
+            lo = jnp.arange(k) * (n // k)
+            width = jnp.maximum(jnp.asarray(n // k), 1)
+            idx = jnp.minimum(lo + (us * width).astype(jnp.int32), n - 1)
+            centroids = arr[idx]
         elif isinstance(self.init, str) and self.init in ("probability_based", "kmeans++"):
-            # kmeans++: iterative distance-weighted sampling (_kcluster.py:141)
-            first = int(ht_random.randint(0, n - 1).item())
-            chosen = [first]
-            centers = arr[jnp.asarray([first])]
-            for _ in range(1, k):
-                centers_ht = DNDarray(
-                    centers, tuple(centers.shape),
-                    types.canonical_heat_type(centers.dtype), None, x.device, x.comm,
-                )
-                d = self._metric(x, centers_ht).larray
-                d2 = jnp.min(d, axis=1)
-                prob = d2 / jnp.sum(d2)
-                u = float(ht_random.rand().item())
-                cum = jnp.cumsum(prob)
-                nxt = int(jnp.searchsorted(cum, u))
-                nxt = min(nxt, n - 1)
-                chosen.append(nxt)
-                centers = arr[jnp.asarray(chosen)]
-            centroids = centers
+            us = ht_random.rand(k).larray.astype(arr.dtype)
+            centroids = _kmeanspp_init(arr, us, k)
         else:
             raise ValueError(
                 f'init needs to be "random", "kmeans++"/"probability_based" or a '

@@ -1,14 +1,19 @@
 """Iterative solvers (reference: heat/core/linalg/solver.py, 274 LoC).
 
-``cg`` (:14) and ``lanczos`` (:69) are built entirely from distributed
-matmuls/reductions, exactly as in the reference — every collective is implicit
-in the sharded ops.
+``cg`` (:14) and ``lanczos`` (:69) are built from distributed
+matmuls/reductions exactly as in the reference, but each full iteration
+loop is one on-device XLA program (``lax.while_loop``/``lax.fori_loop``):
+the reference's per-iteration scalar readbacks (alpha/beta/rsnew ``.item()``
+broadcasts) would cost ~100x an iteration's compute through a remote TPU
+tunnel.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from .. import factories, sanitation, types
@@ -18,29 +23,99 @@ from .basics import matmul, dot, norm, outer, transpose
 __all__ = ["cg", "lanczos"]
 
 
+@jax.jit
+def _cg_loop(A, b, x0, tol, max_iter):
+    """CG iterations fused into one XLA program."""
+
+    def cond(state):
+        _, _, _, rsold, it = state
+        return jnp.logical_and(it < max_iter, rsold > tol * tol)
+
+    def body(state):
+        x, r, p, rsold, it = state
+        Ap = A @ p
+        alpha = rsold / jnp.dot(p, Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = jnp.dot(r, r)
+        p = r + (rsnew / rsold) * p
+        return x, r, p, rsnew, it + 1
+
+    r0 = b - A @ x0
+    init = (x0, r0, r0, jnp.dot(r0, r0), 0)
+    x, _, _, _, n_iter = jax.lax.while_loop(cond, body, init)
+    return x, n_iter
+
+
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
     """Conjugate gradients for SPD systems (reference: solver.py:14)."""
     if A.ndim != 2 or b.ndim != 1 or x0.ndim != 1:
         raise RuntimeError("A needs to be 2-D, b and x0 1-D")
-    x = x0
-    r = b - matmul(A, x.expand_dims(1)).squeeze(1)
-    p = r
-    rsold = float(jnp.dot(r.larray, r.larray))
+    dtype = jnp.promote_types(
+        jnp.promote_types(A.larray.dtype, b.larray.dtype), x0.larray.dtype
+    )
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        dtype = jnp.float32
+    arr = A.larray.astype(dtype)
+    bv = b.larray.astype(dtype)
+    xv = x0.larray.astype(dtype)
+    x, _ = _cg_loop(arr, bv, xv, jnp.asarray(1e-10, dtype), len(b))
+    x_ht = DNDarray(
+        x, tuple(x.shape), types.canonical_heat_type(x.dtype),
+        b.split, b.device, b.comm,
+    )
+    from ..dndarray import _ensure_split
 
-    for _ in range(len(b)):
-        Ap = matmul(A, p.expand_dims(1)).squeeze(1)
-        alpha = rsold / float(jnp.dot(p.larray, Ap.larray))
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rsnew = float(jnp.dot(r.larray, r.larray))
-        if rsnew**0.5 < 1e-10:
-            break
-        p = r + (rsnew / rsold) * p
-        rsold = rsnew
+    x_ht = _ensure_split(x_ht, b.split)
     if out is not None:
-        out.larray = x.larray
+        out.larray = x_ht.larray
         return out
-    return x
+    return x_ht
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def _lanczos_loop(arr, v, m: int):
+    """Three-term Lanczos recurrence with full reorthogonalization, fused
+    into one XLA program.  The basis lives as a row-stacked (m, n) array so
+    reorthogonalization is two matvecs against the filled prefix (masked by
+    iteration index) instead of a Python loop over saved vectors."""
+    n = arr.shape[0]
+    dtype = arr.dtype
+    rows = jnp.arange(m)
+
+    w0 = arr @ v
+    a0 = jnp.dot(w0, v)
+    state = (
+        jnp.zeros((m, n), dtype).at[0].set(v),  # basis V (rows)
+        jnp.zeros((m,), dtype).at[0].set(a0),  # diagonal of T
+        jnp.zeros((max(m - 1, 1),), dtype),  # off-diagonal of T
+        w0 - a0 * v,  # residual w
+    )
+
+    def body(i, state):
+        V, alphas, betas, w = state
+        beta = jnp.linalg.norm(w)
+        breakdown = beta < 1e-10
+        # happy breakdown: restart from a fixed vector; the shared
+        # reorthogonalization below projects out the existing basis either way
+        cand = jnp.where(
+            breakdown, jnp.ones((n,), dtype) / jnp.sqrt(n), w / jnp.maximum(beta, 1e-30)
+        )
+        prefix = (rows < i)[:, None].astype(dtype)
+        cand = cand - (V * prefix).T @ (V @ cand * (rows < i))
+        v_next = cand / jnp.maximum(jnp.linalg.norm(cand), 1e-30)
+        w_new = arr @ v_next
+        alpha = jnp.dot(w_new, v_next)
+        w_new = w_new - alpha * v_next - jnp.where(breakdown, 0.0, beta) * V[i - 1]
+        return (
+            V.at[i].set(v_next),
+            alphas.at[i].set(alpha),
+            betas.at[i - 1].set(beta),
+            w_new,
+        )
+
+    V, alphas, betas, _ = jax.lax.fori_loop(1, m, body, state)
+    return V.T, alphas, betas[: m - 1]
 
 
 def lanczos(
@@ -69,37 +144,7 @@ def lanczos(
     else:
         v = v0.larray / jnp.linalg.norm(v0.larray)
 
-    # classic three-term recurrence with full reorthogonalization (the
-    # reference reorthogonalizes too, solver.py:~130)
-    V = [v]
-    T_alpha = []
-    T_beta = []
-    w = arr @ v
-    alpha = float(jnp.dot(w, v))
-    w = w - alpha * v
-    T_alpha.append(alpha)
-    for i in range(1, m):
-        beta = float(jnp.linalg.norm(w))
-        if beta < 1e-10:
-            # happy breakdown: pad with a random orthogonal continuation
-            vr = jnp.ones_like(v) / jnp.sqrt(n)
-            for u in V:
-                vr = vr - jnp.dot(u, vr) * u
-            v_next = vr / jnp.maximum(jnp.linalg.norm(vr), 1e-30)
-        else:
-            v_next = w / beta
-        # full reorthogonalization against previous basis
-        for u in V:
-            v_next = v_next - jnp.dot(u, v_next) * u
-        v_next = v_next / jnp.maximum(jnp.linalg.norm(v_next), 1e-30)
-        w = arr @ v_next
-        alpha = float(jnp.dot(w, v_next))
-        w = w - alpha * v_next - (beta if beta >= 1e-10 else 0.0) * V[-1]
-        V.append(v_next)
-        T_alpha.append(alpha)
-        T_beta.append(beta)
-
-    Vm = jnp.stack(V, axis=1)  # n × m
+    Vm, T_alpha, T_beta = _lanczos_loop(arr, v, m)
     T = jnp.diag(jnp.asarray(T_alpha, dtype=arr.dtype))
     if m > 1:
         off = jnp.asarray(T_beta, dtype=arr.dtype)

@@ -56,6 +56,35 @@ def _sq_euclidean(xa, ya):
     return jnp.maximum(x2 + y2 - 2.0 * cross, 0.0)
 
 
+_ROWSPLIT_FNS: dict = {}
+
+
+def _rowsplit_fn(mesh, spec, sqrt: bool):
+    """Cache the shard_map'd kernel per (mesh, spec, sqrt): building a fresh
+    closure per call would defeat jit's trace cache and recompile the kernel
+    on every cdist (~12 s per KMeans predict through the remote tunnel)."""
+    key = (mesh, spec, sqrt)
+    fn = _ROWSPLIT_FNS.get(key)
+    if fn is None:
+        from ..ops.cdist import cdist as _fused
+        from ..parallel.collectives import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        import jax
+
+        fn = jax.jit(
+            shard_map(
+                lambda xs, ys: _fused(xs, ys, sqrt=sqrt),
+                mesh=mesh,
+                in_specs=(spec, P()),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )
+        _ROWSPLIT_FNS[key] = fn
+    return fn
+
+
 def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional[DNDarray]:
     """Fused-kernel fast path for the KMeans shape: x row-split, y replicated.
 
@@ -64,10 +93,7 @@ def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional
     a replicated small operand (distance.py:209, size-1 ring degenerate case).
     Returns None when the layout doesn't fit, to fall through to GSPMD.
     """
-    from ..ops.cdist import cdist as _fused
     from ..ops.matmul import _mode
-    from ..parallel.collectives import shard_map
-    from jax.sharding import PartitionSpec as P
 
     # only when the promoted dtype is f32: the kernel accumulates and returns
     # f32, and the GSPMD path must stay the dtype-authoritative fallback
@@ -79,13 +105,9 @@ def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional
     ):
         return None
     comm = x.comm
-    out = shard_map(
-        lambda xs, ys: _fused(xs, ys, sqrt=sqrt),
-        mesh=comm.mesh,
-        in_specs=(comm.spec(0, 2), P()),
-        out_specs=comm.spec(0, 2),
-        check_vma=False,
-    )(x.parray.astype(jnp.float32), ya)
+    out = _rowsplit_fn(comm.mesh, comm.spec(0, 2), sqrt)(
+        x.parray.astype(jnp.float32), ya
+    )
     gshape = (x.shape[0], y.shape[0])
     return DNDarray(
         out, gshape, types.canonical_heat_type(out.dtype), 0, x.device, x.comm
