@@ -187,13 +187,15 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             # one sample per stratum [i*n/k, (i+1)*n/k) — the reference's
             # equal-distribution draw (_kcluster.py:101-123); one batched
             # uniform draw, indices never leave the device
-            us = ht_random.rand(k).larray.astype(arr.dtype)
+            # uniforms stay float32: cast to a half-precision data dtype
+            # would quantize the sampled indices to ~1.7k distinct rows
+            us = ht_random.rand(k).larray.astype(jnp.float32)
             lo = jnp.arange(k) * (n // k)
             width = jnp.maximum(jnp.asarray(n // k), 1)
             idx = jnp.minimum(lo + (us * width).astype(jnp.int32), n - 1)
             centroids = arr[idx]
         elif isinstance(self.init, str) and self.init in ("probability_based", "kmeans++"):
-            us = ht_random.rand(k).larray.astype(arr.dtype)
+            us = ht_random.rand(k).larray.astype(jnp.float32)
             centroids = _kmeanspp_init(arr, us, k)
         else:
             raise ValueError(

@@ -41,16 +41,8 @@ def _shard_map(fn, mesh, in_specs, out_specs):
         return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
-_TSQR_FNS: dict = {}
-
-
-def _tsqr_fn(mesh, axis):
-    """Compiled TSQR kernel per (mesh, axis) — rebuilding the shard_map
-    closure per call would recompile on every qr."""
-    key = (mesh, axis)
-    fn = _TSQR_FNS.get(key)
-    if fn is not None:
-        return fn
+def _build_tsqr(mesh, axis):
+    """TSQR kernel for jit_shard_map_cached (one compile per mesh/axis)."""
 
     def kernel(block):
         # block: (m_local, n) — local panel factorization on the MXU
@@ -72,24 +64,22 @@ def _tsqr_fn(mesh, axis):
         q = jnp.matmul(q1, q2_block, precision=jax.lax.Precision.HIGHEST)
         return q, r
 
-    fn = jax.jit(
-        _shard_map(
-            kernel, mesh,
-            in_specs=(P(axis, None),),
-            out_specs=(P(axis, None), P(None, None)),
-        )
+    return _shard_map(
+        kernel, mesh,
+        in_specs=(P(axis, None),),
+        out_specs=(P(axis, None), P(None, None)),
     )
-    _TSQR_FNS[key] = fn
-    return fn
 
 
 def _tsqr(a: DNDarray, calc_q: bool = True):
     """One-level TSQR tree over the split axis."""
+    from ...parallel.collectives import jit_shard_map_cached
+
     comm = a.comm
     arr = a.larray
     if not jnp.issubdtype(arr.dtype, jnp.inexact):
         arr = arr.astype(jnp.float32)
-    q, r = _tsqr_fn(comm.mesh, comm.split_axis)(arr)
+    q, r = jit_shard_map_cached(_build_tsqr, comm.mesh, comm.split_axis)(arr)
     q_ht = DNDarray(q, tuple(q.shape), types.canonical_heat_type(q.dtype), 0, a.device, comm)
     r_ht = DNDarray(r, tuple(r.shape), types.canonical_heat_type(r.dtype), None, a.device, comm)
     return _ensure_split(q_ht, 0), r_ht

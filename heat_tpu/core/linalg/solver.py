@@ -16,9 +16,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .. import factories, sanitation, types
+from .. import types
 from ..dndarray import DNDarray
-from .basics import matmul, dot, norm, outer, transpose
 
 __all__ = ["cg", "lanczos"]
 

@@ -172,9 +172,13 @@ class DataParallel:
         return out
 
     # ------------------------------------------------------------ train step
-    def train_step(self, batch, targets) -> float:
+    def train_step(self, batch, targets):
         """One fused DP training iteration: forward, backward, gradient
-        all-reduce (implicit psum over the mesh), optimizer update."""
+        all-reduce (implicit psum over the mesh), optimizer update.
+
+        Returns the loss as a 0-d device scalar so back-to-back steps
+        pipeline (through a remote TPU tunnel a blocking per-step readback
+        costs ~250 ms); ``float(loss)`` blocks when the value is needed."""
         if self.params is None:
             raise RuntimeError("call .init(rng, sample_input) first")
         if self.optimizer is None:
@@ -208,7 +212,7 @@ class DataParallel:
             self.variables, self.optimizer.state, bv, tv
         )
         self.params = self.variables.get("params", self.variables)
-        return float(loss)
+        return loss
 
 
 class DataParallelMultiGPU(DataParallel):
@@ -268,7 +272,7 @@ class DataParallelMultiGPU(DataParallel):
         finally:
             self.variables = saved
 
-    def train_step(self, batch, targets) -> float:
+    def train_step(self, batch, targets):
         daso = self._daso()
         if daso is None:
             return super().train_step(batch, targets)
@@ -321,4 +325,4 @@ class DataParallelMultiGPU(DataParallel):
                 daso._build_sync(self.variables)
             self.variables = daso._sync_fn(self.variables)
         self.params = self.variables.get("params", self.variables)
-        return float(loss)
+        return loss

@@ -24,7 +24,7 @@ Exscan/Scan         :func:`exscan`
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Callable, Optional
 
 import jax
@@ -39,6 +39,7 @@ except ImportError:  # pragma: no cover - older jax
 
 __all__ = [
     "shard_map",
+    "jit_shard_map_cached",
     "psum",
     "pmax",
     "pmin",
@@ -52,6 +53,18 @@ __all__ = [
 ]
 
 shard_map = _shard_map
+
+
+@lru_cache(maxsize=None)
+def jit_shard_map_cached(builder: Callable, mesh, *key):
+    """Build-and-jit a shard_map'd kernel once per ``(builder, mesh, *key)``.
+
+    ``builder(mesh, *key)`` must return the shard_map'd callable.  Rebuilding
+    the closure per call would defeat jit's trace cache and recompile the
+    kernel on every invocation (~12 s per call through a remote TPU tunnel);
+    every hot shard_map site (spatial.cdist, linalg TSQR) routes through
+    this cache."""
+    return jax.jit(builder(mesh, *key))
 
 
 def axis_index(axis: str):

@@ -56,33 +56,18 @@ def _sq_euclidean(xa, ya):
     return jnp.maximum(x2 + y2 - 2.0 * cross, 0.0)
 
 
-_ROWSPLIT_FNS: dict = {}
+def _build_rowsplit(mesh, spec, sqrt: bool):
+    from ..ops.cdist import cdist as _fused
+    from ..parallel.collectives import shard_map
+    from jax.sharding import PartitionSpec as P
 
-
-def _rowsplit_fn(mesh, spec, sqrt: bool):
-    """Cache the shard_map'd kernel per (mesh, spec, sqrt): building a fresh
-    closure per call would defeat jit's trace cache and recompile the kernel
-    on every cdist (~12 s per KMeans predict through the remote tunnel)."""
-    key = (mesh, spec, sqrt)
-    fn = _ROWSPLIT_FNS.get(key)
-    if fn is None:
-        from ..ops.cdist import cdist as _fused
-        from ..parallel.collectives import shard_map
-        from jax.sharding import PartitionSpec as P
-
-        import jax
-
-        fn = jax.jit(
-            shard_map(
-                lambda xs, ys: _fused(xs, ys, sqrt=sqrt),
-                mesh=mesh,
-                in_specs=(spec, P()),
-                out_specs=spec,
-                check_vma=False,
-            )
-        )
-        _ROWSPLIT_FNS[key] = fn
-    return fn
+    return shard_map(
+        lambda xs, ys: _fused(xs, ys, sqrt=sqrt),
+        mesh=mesh,
+        in_specs=(spec, P()),
+        out_specs=spec,
+        check_vma=False,
+    )
 
 
 def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional[DNDarray]:
@@ -104,8 +89,10 @@ def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional
         or ya.dtype != jnp.float32
     ):
         return None
+    from ..parallel.collectives import jit_shard_map_cached
+
     comm = x.comm
-    out = _rowsplit_fn(comm.mesh, comm.spec(0, 2), sqrt)(
+    out = jit_shard_map_cached(_build_rowsplit, comm.mesh, comm.spec(0, 2), sqrt)(
         x.parray.astype(jnp.float32), ya
     )
     gshape = (x.shape[0], y.shape[0])
