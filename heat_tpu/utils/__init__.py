@@ -1,6 +1,6 @@
 """Utilities (reference: heat/utils/)."""
 
-from . import checkpointing, data, monitor
+from . import checkpointing, data, monitor, vision_transforms
 from .checkpointing import Checkpointer, load_checkpoint, save_checkpoint
 
 __all__ = [
@@ -10,4 +10,5 @@ __all__ = [
     "load_checkpoint",
     "monitor",
     "save_checkpoint",
+    "vision_transforms",
 ]

@@ -1,0 +1,229 @@
+"""Image transforms (reference: heat/utils/vision_transforms.py).
+
+The reference resolves every name against ``torchvision.transforms`` via a
+module ``__getattr__``.  This rebuild has no torch dependency, so the
+transforms users actually reach for are implemented natively on NumPy host
+arrays (transforms are host-side preprocessing — the device sees the batched
+result); anything not implemented here still falls through to torchvision
+when it happens to be installed, mirroring the reference's behavior.
+
+Layout convention is channels-last (H, W, C) or (H, W), matching the NHWC
+layout of :mod:`heat_tpu.models`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "Compose",
+    "ToTensor",
+    "Normalize",
+    "Lambda",
+    "CenterCrop",
+    "Pad",
+    "RandomCrop",
+    "RandomHorizontalFlip",
+    "RandomVerticalFlip",
+    "Resize",
+    "Grayscale",
+]
+
+
+def _pair(v) -> tuple:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class Compose:
+    """Chain transforms (torchvision.transforms.Compose semantics)."""
+
+    def __init__(self, transforms: Sequence[Callable]):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def __repr__(self):
+        return f"Compose({self.transforms!r})"
+
+
+class ToTensor:
+    """uint8 [0, 255] → float32 [0, 1] (no layout change: NHWC stays NHWC)."""
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        if x.dtype == np.uint8:
+            return x.astype(np.float32) / 255.0
+        return x.astype(np.float32)
+
+
+class Normalize:
+    """Channel-wise (x - mean) / std over the trailing channel axis; for 2-D
+    inputs mean/std are scalars."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+
+    def __call__(self, x):
+        x = np.asarray(x, dtype=np.float32)
+        return (x - self.mean) / self.std
+
+
+class Lambda:
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def __call__(self, x):
+        return self.fn(x)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = _pair(size)
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        th, tw = self.size
+        h, w = x.shape[:2]
+        if h < th or w < tw:
+            # torchvision pads smaller images with zeros before cropping
+            top = max((th - h) // 2, 0)
+            left = max((tw - w) // 2, 0)
+            x = Pad((left, top, tw - w - left if w < tw else 0,
+                     th - h - top if h < th else 0))(x)
+            h, w = x.shape[:2]
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return x[i : i + th, j : j + tw]
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        self.padding = padding if isinstance(padding, (tuple, list)) else (padding,) * 4
+        self.fill = fill
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        if len(self.padding) == 2:
+            left, top = self.padding
+            right, bottom = left, top
+        else:
+            left, top, right, bottom = self.padding
+        pads = [(top, bottom), (left, right)] + [(0, 0)] * (x.ndim - 2)
+        return np.pad(x, pads, constant_values=self.fill)
+
+
+class RandomCrop:
+    def __init__(self, size, padding: Optional[int] = None, seed: Optional[int] = None):
+        self.size = _pair(size)
+        self.padding = padding
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        if self.padding:
+            x = Pad(self.padding)(x)
+        th, tw = self.size
+        h, w = x.shape[:2]
+        if h < th or w < tw:
+            raise ValueError(
+                f"crop size {self.size} larger than image size {(h, w)}"
+            )
+        i = int(self._rng.integers(0, h - th + 1))
+        j = int(self._rng.integers(0, w - tw + 1))
+        return x[i : i + th, j : j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p: float = 0.5, seed: Optional[int] = None):
+        self.p = p
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, x):
+        if self._rng.random() < self.p:
+            return np.asarray(x)[:, ::-1].copy()
+        return np.asarray(x)
+
+
+class RandomVerticalFlip:
+    def __init__(self, p: float = 0.5, seed: Optional[int] = None):
+        self.p = p
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, x):
+        if self._rng.random() < self.p:
+            return np.asarray(x)[::-1].copy()
+        return np.asarray(x)
+
+
+class Resize:
+    """Bilinear resize via jax.image (host arrays in, host arrays out).
+
+    An int size resizes the *shorter edge* preserving aspect ratio, a
+    (h, w) pair resizes exactly — torchvision semantics.  uint8 in →
+    uint8 out, so a following ToTensor still scales by 1/255."""
+
+    def __init__(self, size):
+        self.exact = isinstance(size, (tuple, list))
+        self.size = _pair(size)
+
+    def __call__(self, x):
+        import jax.image
+
+        x = np.asarray(x)
+        h, w = x.shape[:2]
+        if self.exact:
+            th, tw = self.size
+        else:
+            short = self.size[0]
+            if h <= w:
+                th, tw = short, max(int(round(w * short / h)), 1)
+            else:
+                th, tw = max(int(round(h * short / w)), 1), short
+        out = np.asarray(
+            jax.image.resize(
+                x.astype(np.float32), (th, tw) + x.shape[2:], method="bilinear"
+            )
+        )
+        if x.dtype == np.uint8:
+            return np.clip(np.rint(out), 0, 255).astype(np.uint8)
+        return out.astype(x.dtype, copy=False)
+
+
+class Grayscale:
+    """RGB (H, W, 3) → (H, W, out_channels) luma. uint8 in → uint8 out, so
+    a following ToTensor still scales by 1/255."""
+
+    def __init__(self, num_output_channels: int = 1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        luma = x.astype(np.float32) @ np.array(
+            [0.2989, 0.587, 0.114], dtype=np.float32
+        )
+        out = np.repeat(luma[..., None], self.num_output_channels, axis=-1)
+        if x.dtype == np.uint8:
+            return np.clip(np.rint(out), 0, 255).astype(np.uint8)
+        return out.astype(x.dtype, copy=False)
+
+
+def __getattr__(name):
+    # reference behavior: unknown names fall through to torchvision when
+    # available (vision_transforms.py:10-20)
+    try:
+        import torchvision.transforms as _tvt
+
+        return getattr(_tvt, name)
+    except ImportError:
+        raise AttributeError(
+            f"transform {name!r} is not implemented natively and torchvision "
+            "is not installed"
+        )

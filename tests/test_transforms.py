@@ -1,0 +1,93 @@
+"""Vision transforms (reference: heat/utils/vision_transforms.py falls
+through to torchvision; here the common transforms are native NHWC)."""
+
+import numpy as np
+
+from heat_tpu.utils import vision_transforms as T
+
+from .base import TestCase
+
+
+class TestVisionTransforms(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(0)
+        self.img = rng.integers(0, 256, (32, 24, 3), dtype=np.uint8)
+
+    def test_to_tensor_normalize_compose(self):
+        t = T.Compose([T.ToTensor(), T.Normalize(0.5, 0.5)])
+        out = t(self.img)
+        self.assertEqual(out.dtype, np.float32)
+        np.testing.assert_allclose(
+            out, (self.img.astype(np.float32) / 255.0 - 0.5) / 0.5, rtol=1e-6
+        )
+
+    def test_channelwise_normalize(self):
+        t = T.Normalize([0.1, 0.2, 0.3], [1.0, 2.0, 4.0])
+        out = t(self.img.astype(np.float32))
+        np.testing.assert_allclose(out[..., 2], (self.img[..., 2] - 0.3) / 4.0, rtol=1e-5)
+
+    def test_center_crop_and_pad(self):
+        out = T.CenterCrop(16)(self.img)
+        self.assertEqual(out.shape, (16, 16, 3))
+        np.testing.assert_array_equal(out, self.img[8:24, 4:20])
+        padded = T.Pad(2)(self.img)
+        self.assertEqual(padded.shape, (36, 28, 3))
+        np.testing.assert_array_equal(padded[2:-2, 2:-2], self.img)
+
+    def test_random_crop_and_flips_deterministic(self):
+        out = T.RandomCrop(16, seed=0)(self.img)
+        self.assertEqual(out.shape, (16, 16, 3))
+        flipped = T.RandomHorizontalFlip(p=1.0)(self.img)
+        np.testing.assert_array_equal(flipped, self.img[:, ::-1])
+        flipped = T.RandomVerticalFlip(p=0.0)(self.img)
+        np.testing.assert_array_equal(flipped, self.img)
+
+    def test_resize_and_grayscale(self):
+        out = T.Resize((16, 12))(self.img)
+        self.assertEqual(out.shape, (16, 12, 3))
+        self.assertEqual(out.dtype, np.uint8)  # uint8 preserved for ToTensor
+        # int size: shorter edge, aspect preserved (32x24 -> 16 short edge)
+        out = T.Resize(16)(self.img)
+        self.assertEqual(out.shape, (21, 16, 3))
+        g = T.Grayscale()(self.img)
+        self.assertEqual(g.shape, (32, 24, 1))
+        self.assertEqual(g.dtype, np.uint8)
+        g3 = T.Grayscale(3)(self.img)
+        self.assertEqual(g3.shape, (32, 24, 3))
+        # the classic pipeline scales into [-1, 1], not [0, 255]
+        pipe = T.Compose([T.Resize(28), T.ToTensor(), T.Normalize(0.5, 0.5)])
+        out = pipe(self.img)
+        self.assertLessEqual(float(np.abs(out).max()), 1.0 + 1e-6)
+
+    def test_crop_edge_cases(self):
+        small = self.img[:8, :8]
+        out = T.CenterCrop(12)(small)  # pads like torchvision
+        self.assertEqual(out.shape, (12, 12, 3))
+        with self.assertRaises(ValueError):
+            T.RandomCrop(12)(small)
+        with self.assertRaises((TypeError, ValueError)):
+            T.CenterCrop((16.0, "x"))
+        out = T.CenterCrop((16.0, 12.0))(self.img)  # float pairs coerce
+        self.assertEqual(out.shape, (16, 12, 3))
+
+    def test_lambda_and_fallthrough(self):
+        self.assertEqual(T.Lambda(lambda x: x + 1)(1), 2)
+        try:
+            import torchvision  # noqa: F401
+
+            self.assertIsNotNone(T.ColorJitter)
+        except ImportError:
+            with self.assertRaises(AttributeError):
+                T.ColorJitter
+
+    def test_dataset_transform_integration(self):
+        import heat_tpu as ht
+        from heat_tpu.utils.data import Dataset
+
+        x = ht.arange(8 * 4, dtype=ht.float32).reshape((8, 4))
+        t = T.Compose([T.Lambda(lambda v: np.asarray(v) * 2.0)])
+        ds = Dataset(x, transform=lambda v: (t(v),))
+        np.testing.assert_allclose(
+            np.asarray(ds[1][0] if isinstance(ds[1], tuple) else ds[1]),
+            np.arange(4, 8, dtype=np.float32) * 2,
+        )
