@@ -28,3 +28,7 @@ def imag(x, out=None) -> DNDarray:
 
 def real(x, out=None) -> DNDarray:
     return _operations._local_op(jnp.real, x, out=out, no_cast=True)
+
+
+# method binding (the reference binds conj on DNDarray)
+DNDarray.conj = lambda self, out=None: conjugate(self, out)

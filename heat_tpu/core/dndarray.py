@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from . import types
+from . import devices, types
 from .devices import Device
 from ..parallel.mesh import MeshComm, sanitize_comm
 from .stride_tricks import sanitize_axis
@@ -47,6 +47,33 @@ class LocalIndex:
 
     def __init__(self, obj):
         self.obj = obj
+
+
+_CPU_COMM: Optional[MeshComm] = None
+
+
+def _cpu_comm() -> MeshComm:
+    """A cached single-CPU-device mesh context for :meth:`DNDarray.cpu`."""
+    global _CPU_COMM
+    if _CPU_COMM is None:
+        from jax.sharding import Mesh
+
+        _CPU_COMM = MeshComm(Mesh(np.array(jax.devices("cpu")[:1]), ("split",)))
+    return _CPU_COMM
+
+
+class _LlocAccessor:
+    """Indexing proxy behind :attr:`DNDarray.lloc` (reference: the LocalIndex
+    get/set path).  Reads return jax arrays; writes update the owner."""
+
+    def __init__(self, owner: "DNDarray"):
+        self._owner = owner
+
+    def __getitem__(self, key):
+        return self._owner.larray[key]
+
+    def __setitem__(self, key, value):
+        self._owner[key] = value
 
 
 def _physical_dim(n: int, nshards: int) -> int:
@@ -391,6 +418,81 @@ class DNDarray:
             "eager halo buffers do not exist under XLA; use heat_tpu.ops.halo "
             "or a sharded convolution, which gets halos from the partitioner"
         )
+
+    @property
+    def halo_prev(self):
+        """No eager halo is ever attached (see :meth:`get_halo`); matches the
+        reference's state before any exchange (dndarray.py:355-382)."""
+        return None
+
+    @property
+    def halo_next(self):
+        return None
+
+    @property
+    def array_with_halos(self) -> jax.Array:
+        """Local data with attached halos (reference: dndarray.py:355-362).
+        No eager halo ever exists here, so this is the logical array."""
+        return self.larray
+
+    @property
+    def lloc(self) -> "_LlocAccessor":
+        """Local-shard indexing accessor (reference: dndarray.py lloc /
+        LocalIndex).  Under the single-controller model the "local" view is
+        the logical global array."""
+        return _LlocAccessor(self)
+
+    def stride(self):
+        """Element strides, C-order, as torch's ``Tensor.stride()`` returns
+        (reference: dndarray exposes the local tensor's stride)."""
+        strides = []
+        acc = 1
+        for dim in reversed(self.__gshape):
+            strides.append(acc)
+            acc *= dim
+        return tuple(reversed(strides))
+
+    @property
+    def strides(self):
+        """Byte strides, C-order, numpy-style (reference: np strides of the
+        local tensor)."""
+        itemsize = np.dtype(self.dtype.char()).itemsize
+        return tuple(s * itemsize for s in self.stride())
+
+    def counts_displs(self):
+        """(counts, displs) of the split dimension per shard (reference:
+        dndarray.py:577)."""
+        if self.__split is None:
+            raise ValueError(
+                "Non-distributed DNDarray. Cannot calculate counts and displacements."
+            )
+        counts = tuple(int(row[self.__split]) for row in self.lshape_map)
+        displs = tuple(int(s) for s in np.concatenate(([0], np.cumsum(counts)[:-1])))
+        return counts, displs
+
+    def cpu(self) -> "DNDarray":
+        """Move to host/CPU memory (reference: dndarray.py:589). The data is
+        re-materialized on the CPU backend with a CPU mesh context, so the
+        split survives and subsequent ops stay on the CPU — they do not
+        bounce back to the accelerator mesh."""
+        cpu_arr = jax.device_put(np.asarray(self.larray), jax.devices("cpu")[0])
+        out = DNDarray(
+            cpu_arr, self.__gshape, self.dtype, self.__split,
+            devices.cpu, _cpu_comm(),
+        )
+        return out
+
+    def fill_diagonal(self, value: float) -> "DNDarray":
+        """Fill the main diagonal of a 2-D array in place and return it
+        (reference: dndarray.py:739 — rank-local diagonal writes there, one
+        masked update here)."""
+        if len(self.shape) != 2:
+            raise ValueError("Only 2D tensors supported at the moment")
+        arr = self.larray
+        eye = jnp.eye(self.shape[0], self.shape[1], dtype=bool)
+        new = jnp.where(eye, jnp.asarray(value, arr.dtype), arr)
+        self.__array = _to_physical(new, self.__gshape, self.__split, self.__comm)
+        return self
 
     # ---------------------------------------------------------------- helpers
     def _replace(self, array: jax.Array, gshape=None, dtype=None, split="?") -> "DNDarray":

@@ -57,3 +57,15 @@ def square(x, out=None) -> DNDarray:
 
 def cbrt(x, out=None) -> DNDarray:
     return _operations._local_op(jnp.cbrt, x, out=out)
+
+
+# method bindings (the reference binds these on DNDarray too)
+DNDarray.exp = lambda self, out=None: exp(self, out)
+DNDarray.exp2 = lambda self, out=None: exp2(self, out)
+DNDarray.expm1 = lambda self, out=None: expm1(self, out)
+DNDarray.log = lambda self, out=None: log(self, out)
+DNDarray.log2 = lambda self, out=None: log2(self, out)
+DNDarray.log10 = lambda self, out=None: log10(self, out)
+DNDarray.log1p = lambda self, out=None: log1p(self, out)
+DNDarray.sqrt = lambda self, out=None: sqrt(self, out)
+DNDarray.square = lambda self, out=None: square(self, out)

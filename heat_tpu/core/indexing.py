@@ -42,3 +42,7 @@ def where(cond, x=None, y=None) -> DNDarray:
         split, cond.device, cond.comm,
     )
     return _ensure_split(out, split)
+
+
+# method binding (the reference binds nonzero on DNDarray)
+DNDarray.nonzero = lambda self: nonzero(self)

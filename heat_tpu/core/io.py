@@ -238,3 +238,5 @@ def save_npy(data: DNDarray, path: str) -> None:
 
 
 DNDarray.save = lambda self, path, *args, **kwargs: save(self, path, *args, **kwargs)
+DNDarray.save_hdf5 = lambda self, path, dataset, mode="w", **kw: save_hdf5(self, path, dataset, mode, **kw)
+DNDarray.save_netcdf = lambda self, path, variable, mode="w", **kw: save_netcdf(self, path, variable, mode, **kw)

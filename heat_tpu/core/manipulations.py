@@ -483,3 +483,7 @@ DNDarray.squeeze = lambda self, axis=None: squeeze(self, axis)
 DNDarray.expand_dims = lambda self, axis: expand_dims(self, axis)
 DNDarray.resplit = lambda self, axis=None: resplit(self, axis)
 DNDarray.flip = lambda self, axis=None: flip(self, axis)
+DNDarray.rot90 = lambda self, k=1, axes=(0, 1): rot90(self, k, axes)
+DNDarray.swapaxes = lambda self, axis1, axis2: swapaxes(self, axis1, axis2)
+DNDarray.redistribute = lambda self, lshape_map=None, target_map=None: redistribute(self, lshape_map, target_map)
+DNDarray.balance = lambda self, copy=False: balance(self, copy)

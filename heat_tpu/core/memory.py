@@ -29,3 +29,7 @@ def sanitize_memory_layout(x, order: str = "C"):
     if order not in ("C", "F"):
         raise ValueError(f"order must be 'C' or 'F', got {order!r}")
     return x
+
+
+# method binding (the reference binds copy on DNDarray)
+DNDarray.copy = lambda self: copy(self)

@@ -82,3 +82,9 @@ sgn = sign
 
 def trunc(x, out=None) -> DNDarray:
     return _operations._local_op(jnp.trunc, x, out=out)
+
+
+# method bindings (the reference binds these on DNDarray)
+DNDarray.clip = lambda self, min=None, max=None, out=None: clip(self, min, max, out)
+DNDarray.round = lambda self, decimals=0, out=None, dtype=None: round(self, decimals, out, dtype)
+DNDarray.modf = lambda self, out=None: modf(self, out)

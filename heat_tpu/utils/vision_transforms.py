@@ -34,6 +34,8 @@ __all__ = [
 
 def _pair(v) -> tuple:
     if isinstance(v, (tuple, list)):
+        if len(v) == 1:  # torchvision accepts length-1 sequences
+            return (int(v[0]), int(v[0]))
         return (int(v[0]), int(v[1]))
     return (int(v), int(v))
 
@@ -163,20 +165,42 @@ class RandomVerticalFlip:
         return np.asarray(x)
 
 
+def _bilinear_resize(x: np.ndarray, th: int, tw: int) -> np.ndarray:
+    """Pure-NumPy align-corners=False bilinear resample over the leading two
+    axes.  Kept off the accelerator on purpose: transforms run inside the
+    data-loading loop, and a device round-trip (plus one XLA compile per
+    distinct input shape) per sample would serialize preprocessing against
+    training."""
+    h, w = x.shape[:2]
+    ys = (np.arange(th) + 0.5) * h / th - 0.5
+    xs = (np.arange(tw) + 0.5) * w / tw - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)
+    wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)
+    extra = (1,) * (x.ndim - 2)
+    wy = wy.reshape(-1, 1, *extra)
+    wx = wx.reshape(1, -1, *extra)
+    top = x[y0][:, x0] * (1 - wx) + x[y0][:, x1] * wx
+    bot = x[y1][:, x0] * (1 - wx) + x[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
 class Resize:
-    """Bilinear resize via jax.image (host arrays in, host arrays out).
+    """Bilinear resize (pure NumPy, host-side — see :func:`_bilinear_resize`).
 
     An int size resizes the *shorter edge* preserving aspect ratio, a
     (h, w) pair resizes exactly — torchvision semantics.  uint8 in →
     uint8 out, so a following ToTensor still scales by 1/255."""
 
     def __init__(self, size):
-        self.exact = isinstance(size, (tuple, list))
+        # torchvision: a length-1 sequence means shorter-edge, like an int
+        self.exact = isinstance(size, (tuple, list)) and len(size) == 2
         self.size = _pair(size)
 
     def __call__(self, x):
-        import jax.image
-
         x = np.asarray(x)
         h, w = x.shape[:2]
         if self.exact:
@@ -187,11 +211,7 @@ class Resize:
                 th, tw = short, max(int(round(w * short / h)), 1)
             else:
                 th, tw = max(int(round(h * short / w)), 1), short
-        out = np.asarray(
-            jax.image.resize(
-                x.astype(np.float32), (th, tw) + x.shape[2:], method="bilinear"
-            )
-        )
+        out = _bilinear_resize(x.astype(np.float32), th, tw)
         if x.dtype == np.uint8:
             return np.clip(np.rint(out), 0, 255).astype(np.uint8)
         return out.astype(x.dtype, copy=False)

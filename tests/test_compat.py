@@ -148,6 +148,39 @@ class TestCompatSurface(TestCase):
         lines = open(os.path.join(d, "ti", "bad.tfrecord")).read().splitlines()
         self.assertEqual(lines, ["0 19"])
 
+    def test_dndarray_method_surface(self):
+        """Every public method/property the reference binds on DNDarray
+        resolves here (the judge of record: reference dndarray.py plus the
+        DNDarray.x = ... bindings across heat/core)."""
+        a = ht.arange(12, dtype=ht.float32).reshape((3, 4))
+        self.assertTrue(np.allclose(a.exp().numpy(), np.exp(a.numpy())))
+        self.assertTrue(np.allclose(a.clip(2, 8).numpy(), np.clip(a.numpy(), 2, 8)))
+        self.assertEqual(a.swapaxes(0, 1).shape, (4, 3))
+        self.assertEqual(a.rot90().shape, (4, 3))
+        self.assertIs(a.balance(), a)
+        self.assertEqual(a.stride(), (4, 1))
+        self.assertEqual(a.strides, (16, 4))
+        sp = ht.arange(13, split=0)
+        counts, displs = sp.counts_displs()
+        self.assertEqual(sum(counts), 13)
+        self.assertEqual(displs[0], 0)
+        with self.assertRaises(ValueError):
+            ht.arange(4).counts_displs()
+        m = ht.array(np.zeros((3, 3), np.float32)).fill_diagonal(7.0)
+        np.testing.assert_allclose(np.diag(m.numpy()), 7.0)
+        # lloc reads jax arrays, writes through global setitem
+        self.assertEqual(int(sp.lloc[3]), 3)
+        sp.lloc[0] = 99
+        self.assertEqual(int(sp.numpy()[0]), 99)
+        self.assertEqual(sp.array_with_halos.shape, (13,))
+        self.assertIsNone(sp.halo_prev)
+        self.assertIsNone(sp.halo_next)
+        self.assertEqual(sp.cpu().numpy().shape, (13,))
+        for name in ("exp2", "expm1", "log", "log2", "log10", "log1p",
+                     "sqrt", "square", "conj", "copy", "nonzero",
+                     "redistribute", "save_hdf5", "save_netcdf"):
+            self.assertTrue(callable(getattr(a, name)), name)
+
     def test_merge_imagenet_gates_or_rejects_bad_folder(self):
         # RuntimeError when tensorflow/h5py are absent (the gate), otherwise
         # the listdir of a nonexistent folder fails
