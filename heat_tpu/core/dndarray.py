@@ -456,7 +456,7 @@ class DNDarray:
     def strides(self):
         """Byte strides, C-order, numpy-style (reference: np strides of the
         local tensor)."""
-        itemsize = np.dtype(self.dtype.char()).itemsize
+        itemsize = self.dtype.nbytes()  # np.dtype can't parse e.g. 'bf2'
         return tuple(s * itemsize for s in self.stride())
 
     def counts_displs(self):

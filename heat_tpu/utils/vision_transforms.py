@@ -165,27 +165,33 @@ class RandomVerticalFlip:
         return np.asarray(x)
 
 
+def _resample_weights(n_in: int, n_out: int) -> np.ndarray:
+    """(n_out, n_in) triangle-filter weight matrix, align-corners=False.
+
+    On downscale the filter support widens with the scale factor — the
+    antialiasing PIL/torchvision apply; on upscale it reduces to standard
+    bilinear interpolation."""
+    scale = n_in / n_out
+    support = max(scale, 1.0)
+    centers = (np.arange(n_out, dtype=np.float64) + 0.5) * scale - 0.5
+    taps = np.arange(n_in, dtype=np.float64)
+    w = 1.0 - np.abs(centers[:, None] - taps[None, :]) / support
+    w = np.maximum(w, 0.0)
+    return (w / w.sum(axis=1, keepdims=True)).astype(np.float32)
+
+
 def _bilinear_resize(x: np.ndarray, th: int, tw: int) -> np.ndarray:
-    """Pure-NumPy align-corners=False bilinear resample over the leading two
-    axes.  Kept off the accelerator on purpose: transforms run inside the
-    data-loading loop, and a device round-trip (plus one XLA compile per
-    distinct input shape) per sample would serialize preprocessing against
-    training."""
+    """Pure-NumPy separable resample over the leading two axes, antialiased
+    on downscale (PIL/torchvision semantics).  Kept off the accelerator on
+    purpose: transforms run inside the data-loading loop, and a device
+    round-trip (plus one XLA compile per distinct input shape) per sample
+    would serialize preprocessing against training."""
     h, w = x.shape[:2]
-    ys = (np.arange(th) + 0.5) * h / th - 0.5
-    xs = (np.arange(tw) + 0.5) * w / tw - 0.5
-    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
-    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
-    y1 = np.clip(y0 + 1, 0, h - 1)
-    x1 = np.clip(x0 + 1, 0, w - 1)
-    wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)
-    wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)
-    extra = (1,) * (x.ndim - 2)
-    wy = wy.reshape(-1, 1, *extra)
-    wx = wx.reshape(1, -1, *extra)
-    top = x[y0][:, x0] * (1 - wx) + x[y0][:, x1] * wx
-    bot = x[y1][:, x0] * (1 - wx) + x[y1][:, x1] * wx
-    return top * (1 - wy) + bot * wy
+    wy = _resample_weights(h, th)  # (th, h)
+    wx = _resample_weights(w, tw)  # (tw, w)
+    out = np.tensordot(wy, x, axes=(1, 0))  # (th, w, ...)
+    out = np.moveaxis(np.tensordot(wx, out, axes=(1, 1)), 0, 1)  # (th, tw, ...)
+    return out
 
 
 class Resize:
