@@ -13,6 +13,7 @@ layout of :mod:`heat_tpu.models`.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Sequence, Union
 
 import numpy as np
@@ -165,6 +166,7 @@ class RandomVerticalFlip:
         return np.asarray(x)
 
 
+@functools.lru_cache(maxsize=64)
 def _resample_weights(n_in: int, n_out: int) -> np.ndarray:
     """(n_out, n_in) triangle-filter weight matrix, align-corners=False.
 
