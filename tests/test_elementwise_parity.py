@@ -1,0 +1,280 @@
+"""Table-driven NumPy-oracle sweep over the long tail of the ops surface:
+elementwise math, aliases, logical/bitwise families, shape helpers — every
+name checked for split=None/0/1 (reference test convention, SURVEY.md §4)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+SPLITS = (None, 0, 1)
+
+# (name, numpy_fn, domain) — domain picks valid inputs per function
+UNARY = [
+    ("absolute", np.absolute, "any"),
+    ("fabs", np.fabs, "any"),
+    ("neg", np.negative, "any"),
+    ("pos", np.positive, "any"),
+    ("positive", np.positive, "any"),
+    ("sgn", np.sign, "any"),
+    ("signbit", np.signbit, "any"),
+    ("cbrt", np.cbrt, "any"),
+    ("exp", np.exp, "any"),
+    ("expm1", np.expm1, "any"),
+    ("exp2", np.exp2, "any"),
+    ("log", np.log, "pos"),
+    ("log2", np.log2, "pos"),
+    ("log10", np.log10, "pos"),
+    ("log1p", np.log1p, "pos"),
+    ("sqrt", np.sqrt, "pos"),
+    ("square", np.square, "any"),
+    ("sin", np.sin, "any"),
+    ("cos", np.cos, "any"),
+    ("tan", np.tan, "any"),
+    ("sinh", np.sinh, "any"),
+    ("cosh", np.cosh, "any"),
+    ("tanh", np.tanh, "any"),
+    ("arcsin", np.arcsin, "unit"),
+    ("arccos", np.arccos, "unit"),
+    ("arctan", np.arctan, "any"),
+    ("asin", np.arcsin, "unit"),
+    ("acos", np.arccos, "unit"),
+    ("atan", np.arctan, "any"),
+    ("arcsinh", np.arcsinh, "any"),
+    ("arccosh", np.arccosh, "geone"),
+    ("arctanh", np.arctanh, "open_unit"),
+    ("asinh", np.arcsinh, "any"),
+    ("acosh", np.arccosh, "geone"),
+    ("atanh", np.arctanh, "open_unit"),
+    ("deg2rad", np.deg2rad, "any"),
+    ("rad2deg", np.rad2deg, "any"),
+    ("degrees", np.degrees, "any"),
+    ("radians", np.radians, "any"),
+    ("isneginf", np.isneginf, "special"),
+    ("isposinf", np.isposinf, "special"),
+    ("logical_not", np.logical_not, "bool"),
+    ("invert", np.invert, "int"),
+    ("bitwise_not", np.invert, "int"),
+]
+
+BINARY = [
+    ("add", np.add, "any"),
+    ("subtract", np.subtract, "any"),
+    ("mul", np.multiply, "any"),
+    ("div", np.divide, "nonzero"),
+    ("pow", np.power, "pos"),
+    ("power", np.power, "pos"),
+    ("fmod", np.fmod, "nonzero"),
+    ("mod", lambda a, b: np.mod(a, b), "nonzero"),
+    ("floordiv", np.floor_divide, "nonzero"),
+    ("floor_divide", np.floor_divide, "nonzero"),
+    ("arctan2", np.arctan2, "any"),
+    ("atan2", np.arctan2, "any"),
+    ("hypot", np.hypot, "any"),
+    ("copysign", np.copysign, "any"),
+    ("logaddexp", np.logaddexp, "any"),
+    ("logaddexp2", np.logaddexp2, "any"),
+    ("eq", np.equal, "any"),
+    ("ne", np.not_equal, "any"),
+    ("lt", np.less, "any"),
+    ("le", np.less_equal, "any"),
+    ("gt", np.greater, "any"),
+    ("ge", np.greater_equal, "any"),
+    ("less", np.less, "any"),
+    ("less_equal", np.less_equal, "any"),
+    ("greater", np.greater, "any"),
+    ("greater_equal", np.greater_equal, "any"),
+    ("not_equal", np.not_equal, "any"),
+    ("logical_and", np.logical_and, "bool"),
+    ("logical_or", np.logical_or, "bool"),
+    ("logical_xor", np.logical_xor, "bool"),
+    ("bitwise_and", np.bitwise_and, "int"),
+    ("bitwise_or", np.bitwise_or, "int"),
+    ("bitwise_xor", np.bitwise_xor, "int"),
+    ("left_shift", np.left_shift, "shift"),
+    ("right_shift", np.right_shift, "shift"),
+]
+
+
+def _domain(rng, kind, shape=(6, 5)):
+    if kind == "pos":
+        return (rng.random(shape) + 0.5).astype(np.float32)
+    if kind == "unit":
+        return (rng.random(shape) * 1.8 - 0.9).astype(np.float32)
+    if kind == "open_unit":
+        return (rng.random(shape) * 1.6 - 0.8).astype(np.float32)
+    if kind == "geone":
+        return (rng.random(shape) + 1.0).astype(np.float32)
+    if kind == "nonzero":
+        return (rng.random(shape) + 0.5).astype(np.float32) * np.where(rng.random(shape) > 0.5, 1, -1)
+    if kind == "bool":
+        return rng.random(shape) > 0.5
+    if kind == "int":
+        return rng.integers(0, 64, shape, dtype=np.int32)
+    if kind == "shift":
+        return rng.integers(0, 5, shape, dtype=np.int32)
+    if kind == "special":
+        base = rng.standard_normal(shape).astype(np.float32)
+        base[0, 0] = np.inf
+        base[1, 1] = -np.inf
+        return base
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+class TestElementwiseParity(TestCase):
+    def test_unary_table(self):
+        rng = np.random.default_rng(0)
+        for name, np_fn, domain in UNARY:
+            A = _domain(rng, domain)
+            want = np_fn(A)
+            for split in SPLITS:
+                got = getattr(ht, name)(ht.array(A, split=split)).numpy()
+                np.testing.assert_allclose(
+                    got, want, rtol=2e-5, atol=1e-6, err_msg=f"{name} split={split}"
+                )
+
+    def test_binary_table(self):
+        rng = np.random.default_rng(1)
+        for name, np_fn, domain in BINARY:
+            A, B = _domain(rng, domain), _domain(rng, domain)
+            want = np_fn(A, B)
+            for split in SPLITS:
+                got = getattr(ht, name)(
+                    ht.array(A, split=split), ht.array(B, split=split)
+                ).numpy()
+                np.testing.assert_allclose(
+                    got, want, rtol=2e-5, atol=1e-6, err_msg=f"{name} split={split}"
+                )
+
+    def test_complex_family(self):
+        rng = np.random.default_rng(2)
+        C = (rng.standard_normal((4, 3)) + 1j * rng.standard_normal((4, 3))).astype(np.complex64)
+        c = ht.array(C)
+        np.testing.assert_allclose(ht.conjugate(c).numpy(), np.conj(C), rtol=1e-6)
+        np.testing.assert_allclose(ht.angle(c).numpy(), np.angle(C), rtol=1e-5)
+        np.testing.assert_allclose(ht.imag(c).numpy(), C.imag, rtol=1e-6)
+        self.assertTrue(bool(np.all(ht.iscomplex(c).numpy() == np.iscomplex(C))))
+        R = rng.standard_normal((4, 3)).astype(np.float32)
+        self.assertTrue(bool(np.all(ht.isreal(ht.array(R)).numpy())))
+
+    def test_splits_and_stacks(self):
+        rng = np.random.default_rng(3)
+        A = rng.standard_normal((6, 4, 2)).astype(np.float32)
+        for split in SPLITS:
+            a = ht.array(A, split=split)
+            for got, want in zip(ht.vsplit(a, 3), np.vsplit(A, 3)):
+                np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+            for got, want in zip(ht.hsplit(a, 2), np.hsplit(A, 2)):
+                np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+            for got, want in zip(ht.dsplit(a, 2), np.dsplit(A, 2)):
+                np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+        M = rng.standard_normal((5, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            ht.column_stack((ht.array(M[:, 0]), ht.array(M[:, 1]))).numpy(),
+            np.column_stack((M[:, 0], M[:, 1])), rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            ht.row_stack((ht.array(M), ht.array(M))).numpy(), np.vstack((M, M)), rtol=1e-6
+        )
+        np.testing.assert_allclose(ht.flipud(ht.array(M, split=0)).numpy(), np.flipud(M))
+        np.testing.assert_allclose(ht.ravel(ht.array(M, split=0)).numpy(), M.ravel())
+        np.testing.assert_allclose(
+            ht.moveaxis(ht.array(A, split=0), 0, 2).numpy(), np.moveaxis(A, 0, 2)
+        )
+        ba = ht.broadcast_arrays(ht.array(M), ht.array(M[:1]))
+        np.testing.assert_allclose(ba[1].numpy(), np.broadcast_to(M[:1], M.shape))
+
+    def test_linalg_tail(self):
+        rng = np.random.default_rng(4)
+        u = rng.standard_normal(3).astype(np.float64)
+        v = rng.standard_normal(3).astype(np.float64)
+        np.testing.assert_allclose(
+            ht.linalg.cross(ht.array(u), ht.array(v)).numpy(), np.cross(u, v), rtol=1e-8
+        )
+        np.testing.assert_allclose(
+            ht.linalg.vecdot(ht.array(u), ht.array(v)).numpy(), np.vdot(u, v), rtol=1e-8
+        )
+        # projection of u onto v
+        want = (np.dot(u, v) / np.dot(v, v)) * v
+        np.testing.assert_allclose(
+            ht.linalg.projection(ht.array(u), ht.array(v)).numpy(), want, rtol=1e-8
+        )
+        M = rng.standard_normal((4, 4))
+        np.testing.assert_allclose(
+            ht.linalg.matrix_norm(ht.array(M)).numpy(), np.linalg.norm(M), rtol=1e-8
+        )
+        np.testing.assert_allclose(
+            ht.transpose(ht.array(M, split=0)).numpy(), M.T, rtol=1e-8
+        )
+
+    def test_reductions_tail(self):
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal((5, 4)).astype(np.float32)
+        A[0, 0] = np.nan
+        for split in SPLITS:
+            a = ht.array(A, split=split)
+            np.testing.assert_allclose(ht.nansum(a).numpy(), np.nansum(A), rtol=1e-5)
+            np.testing.assert_allclose(ht.nanprod(a).numpy(), np.nanprod(A), rtol=1e-5)
+        B = np.abs(rng.standard_normal(20)).astype(np.float32)
+        np.testing.assert_allclose(
+            ht.histc(ht.array(B, split=0), bins=5).numpy(),
+            np.histogram(B, bins=5, range=(float(B.min()), float(B.max())))[0],
+        )
+        np.testing.assert_allclose(
+            ht.cumproduct(ht.array(B[:6], split=0), 0).numpy(), np.cumprod(B[:6]), rtol=1e-5
+        )
+
+    def test_io_tail(self):
+        import os
+        import tempfile
+
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal((7, 3)).astype(np.float32)
+        d = tempfile.mkdtemp()
+        ht.save_npy(ht.array(A, split=0), os.path.join(d, "a.npy"))
+        np.testing.assert_allclose(
+            ht.load_npy(os.path.join(d, "a.npy"), split=0).numpy(), A, rtol=1e-6
+        )
+        ht.save_csv(ht.array(A, split=0), os.path.join(d, "a.csv"))
+        np.testing.assert_allclose(
+            ht.load_csv(os.path.join(d, "a.csv"), split=0).numpy(), A, rtol=1e-4
+        )
+        self.assertIsInstance(ht.supports_hdf5(), bool)
+
+    def test_printing_and_device_toggles(self):
+        opts = ht.get_printoptions()
+        self.assertIn("precision", opts)
+        ht.set_printoptions(precision=3)
+        self.assertEqual(ht.get_printoptions()["precision"], 3)
+        ht.set_printoptions(precision=opts["precision"])
+        ht.local_printing()
+        ht.global_printing()
+        ht.print0("")  # must not raise
+        dev = ht.get_device()
+        ht.use_device(dev)
+        self.assertIs(ht.get_device(), dev)
+        self.assertIsInstance(ht.sanitize_device(None), ht.Device)
+
+    def test_partitioned_roundtrip(self):
+        a = ht.arange(16, dtype=ht.float32, split=0)
+        part = a.__partitioned__
+        b = ht.from_partitioned(a)
+        np.testing.assert_allclose(b.numpy(), a.numpy())
+        self.assertEqual(part["shape"], (16,))
+
+    def test_type_predicates(self):
+        self.assertTrue(ht.heat_type_is_exact(ht.int32))
+        self.assertTrue(ht.heat_type_is_inexact(ht.float32))
+        self.assertTrue(ht.heat_type_is_complexfloating(ht.complex64))
+        self.assertIs(ht.result_type(ht.int32, ht.float32), ht.float32)
+        self.assertIs(ht.bool_, ht.bool)
+        self.assertIs(ht.half, ht.float16)
+        self.assertIs(ht.cfloat, ht.complex64)
+        self.assertIs(ht.cdouble, ht.complex128)
+        self.assertIs(ht.double, ht.float64)
+        for abstract in (ht.datatype, ht.number, ht.flexible,
+                         ht.signedinteger, ht.unsignedinteger):
+            self.assertTrue(isinstance(abstract, type))
+        self.assertTrue(ht.is_regressor(ht.regression.Lasso()))
+        self.assertFalse(ht.is_transformer(ht.regression.Lasso()))
