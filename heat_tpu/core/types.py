@@ -376,8 +376,22 @@ def _np_equivalent(ht_dtype: Type[datatype]):
     return np.dtype(t)
 
 
-def can_cast(from_: Any, to: Any, casting: str = "safe") -> builtins.bool:
-    """NumPy-semantics castability over heat types (reference: types.py:671)."""
+def _cast_kind(t: Type[datatype]) -> str:
+    if t is bool:
+        return "b"
+    if issubclass(t, unsignedinteger):
+        return "u"
+    if issubclass(t, signedinteger):
+        return "i"
+    if issubclass(t, floating):
+        return "f"
+    return "c"
+
+
+def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
+    """Castability over heat types (reference: types.py:671).  The default
+    ``"intuitive"`` rule is the reference's: everything ``"safe"`` allows,
+    plus int→float of the *same* bit length (e.g. int32→float32)."""
     if not isinstance(from_, type):
         # scalars / arrays: use their inferred type
         try:
@@ -387,32 +401,100 @@ def can_cast(from_: Any, to: Any, casting: str = "safe") -> builtins.bool:
     else:
         from_ = canonical_heat_type(from_)
     to = canonical_heat_type(to)
+    if casting == "intuitive":
+        if np.can_cast(_np_equivalent(from_), _np_equivalent(to), casting="safe"):
+            return True
+        to_bits = to.nbytes() // 2 if _cast_kind(to) == "c" else to.nbytes()
+        return (
+            _cast_kind(from_) in ("u", "i")
+            and _cast_kind(to) in ("f", "c")
+            and to_bits >= from_.nbytes()
+        )
     return np.can_cast(_np_equivalent(from_), _np_equivalent(to), casting=casting)
 
 
 def promote_types(type1: Any, type2: Any) -> Type[datatype]:
-    """Smallest common safe type (reference: types.py:836). Delegates to
-    jnp.promote_types so bfloat16 participates correctly."""
-    t1 = canonical_heat_type(type1)
-    t2 = canonical_heat_type(type2)
-    return canonical_heat_type(jnp.promote_types(t1.jax_type(), t2.jax_type()))
+    """Smallest type both operands can "intuitively" cast to
+    (reference: types.py:836 and its doctests — same-bitlength promotion:
+    int32+float32→float32, int64+float32→float64, int8+uint8→int16 — not
+    numpy's widening).  bfloat16, absent from the reference lattice, follows
+    jax: it wins against same-or-narrower ints and meets float16 at
+    float32."""
+    a = canonical_heat_type(type1)
+    b = canonical_heat_type(type2)
+    if a is b:
+        return a
+    if {a, b} == {bfloat16, float16}:
+        # no common exact 2-byte float: meet at float32 (jax rule)
+        return float32
+    ka, kb = _cast_kind(a), _cast_kind(b)
+    order = "buifc"
+    if order.index(ka) > order.index(kb):
+        a, b, ka, kb = b, a, kb, ka
+    if ka == "b":
+        return b
+    na, nb = a.nbytes(), b.nbytes()
+    if ka == kb:
+        return a if na >= nb else b
+    if ka == "u" and kb == "i":
+        # signed type wide enough for the unsigned range (uint8→int16 floor)
+        if nb > na:
+            return b
+        return {1: int16, 2: int32, 4: int64}.get(na, int64)
+    if kb == "f":
+        # int vs float: the float operand survives if it is at least as
+        # wide (bfloat16 included — keeps its identity against u8/i8/i16);
+        # a wider int forces the same-bitlength float
+        if na <= nb:
+            return b
+        return {4: float32}.get(na, float64)
+    # kb == "c": the real part must carry the wider operand
+    real = max(na if ka != "c" else na // 2, nb // 2)
+    return complex64 if real <= 4 else complex128
 
 
 def result_type(*operands: Any) -> Type[datatype]:
-    """Scalar-aware promotion across DNDarrays/scalars/dtypes (reference:
-    types.py:868). Delegates to jnp.result_type (NumPy promotion rules with
-    weak scalar types)."""
+    """Promotion across arrays/types/scalars with the reference's precedence
+    rules (types.py:868): arrays > named types > python scalars within the
+    same kind (a scalar never widens an array of its own kind); across
+    kinds the higher kind wins (an int array + float scalar goes float)."""
     from .dndarray import DNDarray
 
-    args = []
-    for op in operands:
+    def classify(op):
         if isinstance(op, DNDarray):
-            args.append(op.larray)
-        elif isinstance(op, type) and issubclass(op, datatype):
-            args.append(op.jax_type())
-        else:
-            args.append(op)
-    return canonical_heat_type(jnp.result_type(*args))
+            return op.dtype, 0 if op.ndim > 0 else 2
+        if isinstance(op, np.ndarray):
+            t = canonical_heat_type(op.dtype)
+            return t, 0 if op.ndim > 0 else 2
+        if hasattr(op, "dtype") and hasattr(op, "shape"):  # jax arrays
+            return canonical_heat_type(op.dtype), 0 if op.ndim > 0 else 2
+        try:
+            return canonical_heat_type(op), 1
+        except TypeError:
+            return heat_type_of(op), 3
+
+    def combine(t1, p1, t2, p2):
+        if t1 is t2:
+            return t1, min(p1, p2)
+        if p1 == p2:
+            return promote_types(t1, t2), p1
+        for parent in (bool, integer, floating, complexfloating):
+            if issubdtype(t1, parent) and issubdtype(t2, parent):
+                return (t1, min(p1, p2)) if p1 < p2 else (t2, min(p1, p2))
+        order = "buifc"
+        k1, k2 = order.index(_cast_kind(t1)), order.index(_cast_kind(t2))
+        return (t2, min(p1, p2)) if k1 < k2 else (t1, min(p1, p2))
+
+    if not operands:
+        raise TypeError("result_type requires at least one operand")
+    # fold from the right, exactly like the reference's recursion
+    # (types.py:916: rec(a, b, c) = combine(a, rec(b, c))) — the fold
+    # direction is observable when a cross-kind scalar sits between arrays
+    t, p = classify(operands[-1])
+    for op in reversed(operands[:-1]):
+        t2, p2 = classify(op)
+        t, p = combine(t2, p2, t, p)
+    return t
 
 
 def iscomplex(x) -> "Any":
