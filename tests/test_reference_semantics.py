@@ -1,0 +1,172 @@
+"""Deep reference-behavior tests: the semantics the reference's own suite
+pins down beyond name/shape parity — RNG split-invariance, convolution
+modes, weighted statistics, unique's inverse contract, dtype promotion."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+SPLITS = (None, 0)
+
+
+class TestRandomInvariance(TestCase):
+    def test_same_seed_same_numbers_any_split(self):
+        """The reference's core RNG guarantee (random.py:55-201): identical
+        global numbers no matter how the array is distributed."""
+        outs = []
+        for split in (None, 0):
+            ht.random.seed(1234)
+            outs.append(ht.random.randn(37, 5, split=split).numpy())
+        np.testing.assert_array_equal(outs[0], outs[1])
+        for fn in (
+            lambda s: ht.random.rand(23, split=s),
+            lambda s: ht.random.randint(0, 100, (23,), split=s),
+            lambda s: ht.random.normal(2.0, 0.5, (23,), split=s),
+            lambda s: ht.random.random_sample((23,), split=s),
+        ):
+            ht.random.seed(77)
+            a = fn(0).numpy()
+            ht.random.seed(77)
+            b = fn(None).numpy()
+            np.testing.assert_array_equal(a, b)
+
+    def test_state_roundtrip(self):
+        ht.random.seed(5)
+        state = ht.random.get_state()
+        a = ht.random.rand(9).numpy()
+        ht.random.set_state(state)
+        np.testing.assert_array_equal(ht.random.rand(9).numpy(), a)
+
+    def test_permutation_is_a_permutation(self):
+        ht.random.seed(3)
+        p = ht.random.randperm(31).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(31))
+        x = ht.arange(12, split=0)
+        shuffled = ht.random.permutation(x).numpy()
+        np.testing.assert_array_equal(np.sort(shuffled), np.arange(12))
+
+
+class TestConvolveModes(TestCase):
+    def test_full_same_valid_vs_numpy(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal(37).astype(np.float32)
+        for klen in (3, 4, 9):
+            v = rng.standard_normal(klen).astype(np.float32)
+            for mode in ("full", "same", "valid"):
+                want = np.convolve(a, v, mode=mode)
+                for split in SPLITS:
+                    got = ht.convolve(
+                        ht.array(a, split=split), ht.array(v), mode=mode
+                    ).numpy()
+                    np.testing.assert_allclose(
+                        got, want, rtol=1e-4, atol=1e-5,
+                        err_msg=f"mode={mode} klen={klen} split={split}",
+                    )
+
+
+class TestStatisticsSemantics(TestCase):
+    def test_weighted_average_returned(self):
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((11, 4)).astype(np.float32)
+        w = rng.random(11).astype(np.float32)
+        want, wsum = np.average(A, axis=0, weights=w, returned=True)
+        for split in SPLITS:
+            got, gsum = ht.average(
+                ht.array(A, split=split), axis=0,
+                weights=ht.array(w, split=split), returned=True,
+            )
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+            np.testing.assert_allclose(np.broadcast_to(gsum.numpy(), want.shape),
+                                       np.broadcast_to(wsum, want.shape), rtol=1e-5)
+
+    def test_cov(self):
+        rng = np.random.default_rng(2)
+        A = rng.standard_normal((5, 40)).astype(np.float64)
+        for split in SPLITS:
+            got = ht.cov(ht.array(A, split=split)).numpy()
+            np.testing.assert_allclose(got, np.cov(A), rtol=1e-6)
+        got = ht.cov(ht.array(A.T, split=0), rowvar=False).numpy()
+        np.testing.assert_allclose(got, np.cov(A), rtol=1e-6)
+
+    def test_kurtosis_vs_scipy(self):
+        from scipy import stats
+
+        rng = np.random.default_rng(3)
+        V = rng.standard_normal(200).astype(np.float64)
+        got = float(ht.statistics.kurtosis(ht.array(V, split=0), unbiased=False))
+        want = stats.kurtosis(V, fisher=True, bias=True)
+        self.assertAlmostEqual(got, want, places=5)
+
+    def test_bincount_digitize_bucketize(self):
+        x = np.array([0, 1, 1, 3, 2, 1, 7], dtype=np.int32)
+        np.testing.assert_array_equal(
+            ht.bincount(ht.array(x, split=0)).numpy(), np.bincount(x)
+        )
+        data = np.array([0.2, 6.4, 3.0, 1.6], dtype=np.float32)
+        bins = np.array([0.0, 1.0, 2.5, 4.0, 10.0], dtype=np.float32)
+        np.testing.assert_array_equal(
+            ht.digitize(ht.array(data, split=0), ht.array(bins)).numpy(),
+            np.digitize(data, bins),
+        )
+
+
+class TestUniqueRepeatTile(TestCase):
+    def test_unique_inverse_contract(self):
+        x = np.array([3, 1, 2, 3, 1, 9, 2], dtype=np.int32)
+        for split in SPLITS:
+            vals, inverse = ht.unique(
+                ht.array(x, split=split), sorted=True, return_inverse=True
+            )
+            vals, inverse = vals.numpy(), inverse.numpy()
+            np.testing.assert_array_equal(vals, np.unique(x))
+            # the defining property: vals[inverse] reconstructs the input
+            np.testing.assert_array_equal(vals[inverse.ravel()].reshape(x.shape), x)
+
+    def test_repeat_and_tile(self):
+        rng = np.random.default_rng(4)
+        A = rng.standard_normal((3, 4)).astype(np.float32)
+        for split in SPLITS:
+            a = ht.array(A, split=split)
+            np.testing.assert_allclose(
+                ht.repeat(a, 2, axis=0).numpy(), np.repeat(A, 2, axis=0)
+            )
+            np.testing.assert_allclose(
+                ht.repeat(a, 3).numpy(), np.repeat(A, 3)
+            )
+            np.testing.assert_allclose(
+                ht.tile(a, (2, 3)).numpy(), np.tile(A, (2, 3))
+            )
+
+
+class TestPromotionRules(TestCase):
+    def test_promote_grid(self):
+        """The reference uses same-bitlength ("intuitive") promotion, not
+        numpy's widening — its own doctests (types.py:852-860): int32+float32
+        stays float32, int8+uint8 widens to int16, int64+float32 needs
+        float64."""
+        cases = [
+            (ht.uint8, ht.uint8, ht.uint8),
+            (ht.int8, ht.uint8, ht.int16),
+            (ht.int32, ht.float32, ht.float32),
+            (ht.int64, ht.float32, ht.float64),
+            (ht.bool, ht.int8, ht.int8),
+            (ht.float32, ht.float64, ht.float64),
+            (ht.float32, ht.complex64, ht.complex64),
+        ]
+        for a, b, want in cases:
+            self.assertIs(ht.promote_types(a, b), want, f"{a} + {b}")
+            self.assertIs(ht.promote_types(b, a), want)
+
+    def test_scalar_aware_result_type(self):
+        # python scalar does not widen an array dtype (reference result_type)
+        self.assertIs(ht.result_type(ht.array(np.float32(1.0)), 2.0), ht.float32)
+        self.assertIs(ht.result_type(ht.array(np.int16(1)), 2), ht.int16)
+
+    def test_binary_op_promotes_like_reference(self):
+        a = ht.array(np.array([1, 2], dtype=np.int32), split=0)
+        b = ht.array(np.array([0.5, 0.5], dtype=np.float32), split=0)
+        self.assertIs((a + b).dtype, ht.float32)  # same-bitlength promotion
+        c = ht.array(np.array([1, 2], dtype=np.uint8))
+        self.assertIs((a + c).dtype, ht.int32)
