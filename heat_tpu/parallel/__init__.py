@@ -20,6 +20,8 @@ from .mesh import (
     local_mesh,
 )
 from . import collectives
+from . import pipeline
+from .pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
     "Communication",
@@ -30,4 +32,7 @@ __all__ = [
     "world",
     "local_mesh",
     "collectives",
+    "pipeline",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

@@ -39,6 +39,7 @@ except ImportError:  # pragma: no cover - older jax
 
 __all__ = [
     "shard_map",
+    "shard_map_unchecked",
     "jit_shard_map_cached",
     "psum",
     "pmax",
@@ -53,6 +54,19 @@ __all__ = [
 ]
 
 shard_map = _shard_map
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across jax versions (the
+    kwarg is ``check_vma`` on jax>=0.6, ``check_rep`` before)."""
+    try:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
 
 
 @lru_cache(maxsize=None)

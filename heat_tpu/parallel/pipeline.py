@@ -1,0 +1,116 @@
+"""Pipeline parallelism (beyond the reference: SURVEY.md §2.5 lists PP as
+absent there — no parity requirement; this is the TPU-native extension).
+
+A GPipe-style microbatch pipeline over a mesh axis: every device owns one
+*stage* (a slice of a stack of structurally identical layers), activations
+flow stage-to-stage with ``lax.ppermute``, and the whole schedule — fill,
+steady state, drain — is one ``lax.scan`` inside ``shard_map``.  Because the
+schedule is ordinary traced code, ``jax.grad`` through it yields the reverse
+pipeline automatically; no hand-built backward schedule exists.
+
+Layout contract: stage parameters are stacked on a leading axis of size
+``n_stages`` sharded over the pipeline mesh axis, exactly how
+:class:`heat_tpu.optim.DASO` stacks slice parameters over its dcn axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .collectives import shard_map_unchecked
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(params_list, mesh: Mesh, axis: str = "pp"):
+    """Stack per-stage parameter trees on a leading dim sharded over the
+    pipeline axis. All stages must share one tree structure."""
+    n_stages = int(mesh.shape[axis])
+    if len(params_list) != n_stages:
+        raise ValueError(
+            f"{len(params_list)} stage trees for a {n_stages}-way {axis!r} axis"
+        )
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+    def place(x):
+        spec = P(*([axis] + [None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, stacked)
+
+
+def pipeline_apply(
+    fn: Callable,
+    stage_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_micro: int,
+):
+    """Run ``x`` through the stage pipeline; returns the final activations.
+
+    Parameters
+    ----------
+    fn : callable
+        ``fn(stage_param_tree, activation) -> activation`` — one stage's
+        compute. Activation shape must be preserved (stage-homogeneous
+        pipelines, e.g. stacked transformer blocks).
+    stage_params :
+        Tree whose leaves carry a leading ``n_stages`` dim sharded over
+        ``axis`` (see :func:`stack_stage_params`).
+    x : jax.Array
+        Batch, leading dim divisible by ``n_micro``.
+    n_micro : int
+        Microbatch count. Pipeline bubble fraction is
+        ``(n_stages - 1) / (n_micro + n_stages - 1)``.
+    """
+    n_stages = int(mesh.shape[axis])
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stage_params leading dim(s) {sorted(leading)} must equal the "
+            f"mesh's {axis!r} axis size {n_stages}"
+        )
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by n_micro={n_micro}")
+    micro = x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+    def shard_fn(p, xs):
+        # p: this stage's params (leading dim 1); xs: all microbatches,
+        # replicated (the fill logic injects them on stage 0 only)
+        idx = lax.axis_index(axis)
+        stage_p = jax.tree.map(lambda a: a[0], p)
+        ticks = n_micro + n_stages - 1
+        zero = jnp.zeros_like(xs[0])
+
+        def tick(carry, t):
+            incoming = carry  # activation handed to me by the previous stage
+            inject = xs[jnp.clip(t, 0, n_micro - 1)]
+            cur = jnp.where((idx == 0) & (t < n_micro), inject, incoming)
+            out = fn(stage_p, cur)
+            nxt = lax.ppermute(
+                out, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            )
+            # the last stage's output for microbatch (t - n_stages + 1)
+            emit = jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out))
+            return nxt, emit
+
+        _, emitted = lax.scan(tick, zero, jnp.arange(ticks))
+        # valid outputs occupy ticks [n_stages-1, ticks); psum replicates
+        # them (every stage but the last contributed zeros)
+        outs = lax.psum(emitted[n_stages - 1 :], axis_name=axis)
+        return outs
+
+    in_specs = (
+        jax.tree.map(lambda _: P(axis), stage_params),
+        P(),
+    )
+    fn_sharded = shard_map_unchecked(shard_fn, mesh, in_specs, P())
+    outs = fn_sharded(stage_params, micro)
+    return outs.reshape((outs.shape[0] * outs.shape[1],) + outs.shape[2:])

@@ -1,0 +1,131 @@
+"""Pipeline parallelism (heat_tpu.parallel.pipeline — a beyond-the-reference
+capability; the reference has no PP, SURVEY.md §2.5)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from heat_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+from .base import TestCase
+
+
+def _stage(p, x):
+    """One homogeneous stage: Dense + tanh."""
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+class TestPipeline(TestCase):
+    def _setup(self, n_stages=4, width=8, seed=0):
+        devices = np.array(jax.devices()[:n_stages])
+        mesh = Mesh(devices, ("pp",))
+        rng = np.random.default_rng(seed)
+        params = [
+            {
+                "w": jnp.asarray(rng.standard_normal((width, width)) / np.sqrt(width), jnp.float32),
+                "b": jnp.asarray(rng.standard_normal(width) * 0.01, jnp.float32),
+            }
+            for _ in range(n_stages)
+        ]
+        return mesh, params
+
+    def test_matches_sequential_forward(self):
+        mesh, params = self._setup()
+        stacked = stack_stage_params(params, mesh)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+
+        got = pipeline_apply(_stage, stacked, x, mesh=mesh, n_micro=4)
+        want = x
+        for p in params:
+            want = _stage(p, want)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    def test_single_microbatch_and_uneven_micro(self):
+        mesh, params = self._setup()
+        stacked = stack_stage_params(params, mesh)
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((12, 8)), jnp.float32)
+        want = x
+        for p in params:
+            want = _stage(p, want)
+        for n_micro in (1, 2, 3, 6, 12):
+            got = pipeline_apply(_stage, stacked, x, mesh=mesh, n_micro=n_micro)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6,
+                err_msg=f"n_micro={n_micro}",
+            )
+        with self.assertRaises(ValueError):
+            pipeline_apply(_stage, stacked, x, mesh=mesh, n_micro=5)
+
+    def test_stage_count_mismatch_rejected(self):
+        mesh, params = self._setup()
+        with self.assertRaises(ValueError):
+            stack_stage_params(params + params, mesh)  # 8 stages, 4-way axis
+        # a hand-stacked tree with the wrong leading dim is also rejected
+        import jax.numpy as jnp
+
+        bad = jax.tree.map(lambda *xs: jnp.stack(xs), *(params + params))
+        x = jnp.zeros((8, 8), jnp.float32)
+        with self.assertRaises(ValueError):
+            pipeline_apply(_stage, bad, x, mesh=mesh, n_micro=2)
+
+    def test_gradients_match_sequential(self):
+        """jax.grad through the scan/ppermute schedule == sequential grads —
+        the automatic reverse pipeline."""
+        mesh, params = self._setup()
+        stacked = stack_stage_params(params, mesh)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+
+        def loss_pp(sp):
+            out = pipeline_apply(_stage, sp, x, mesh=mesh, n_micro=2)
+            return jnp.mean((out - y) ** 2)
+
+        def loss_seq(plist):
+            out = x
+            for p in plist:
+                out = _stage(p, out)
+            return jnp.mean((out - y) ** 2)
+
+        g_pp = jax.grad(loss_pp)(stacked)
+        g_seq = jax.grad(loss_seq)(params)
+        for i in range(4):
+            np.testing.assert_allclose(
+                np.asarray(g_pp["w"][i]), np.asarray(g_seq[i]["w"]),
+                rtol=1e-4, atol=1e-6,
+            )
+            np.testing.assert_allclose(
+                np.asarray(g_pp["b"][i]), np.asarray(g_seq[i]["b"]),
+                rtol=1e-4, atol=1e-6,
+            )
+
+    def test_training_reduces_loss(self):
+        import optax
+
+        mesh, params = self._setup()
+        stacked = stack_stage_params(params, mesh)
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((16, 8)) * 0.1, jnp.float32)
+        tx = optax.adam(1e-2)
+        state = tx.init(stacked)
+
+        @jax.jit
+        def step(sp, st):
+            def loss(sp):
+                out = pipeline_apply(_stage, sp, x, mesh=mesh, n_micro=4)
+                return jnp.mean((out - y) ** 2)
+
+            l, g = jax.value_and_grad(loss)(sp)
+            u, st2 = tx.update(g, st, sp)
+            return optax.apply_updates(sp, u), st2, l
+
+        losses = []
+        for _ in range(30):
+            stacked, state, l = step(stacked, state)
+            losses.append(float(l))
+        self.assertLess(losses[-1], losses[0] * 0.5, losses[:3] + losses[-3:])
