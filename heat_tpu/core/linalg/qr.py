@@ -27,18 +27,11 @@ from jax.sharding import PartitionSpec as P
 
 from .. import sanitation, types
 from ..dndarray import DNDarray, _ensure_split
-from ...parallel.collectives import shard_map
+from ...parallel.collectives import shard_map_unchecked as _shard_map
 
 __all__ = ["qr"]
 
 QR = collections.namedtuple("QR", "Q, R")
-
-
-def _shard_map(fn, mesh, in_specs, out_specs):
-    try:
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
-    except TypeError:  # older jax: check_rep
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
 def _build_tsqr(mesh, axis):
