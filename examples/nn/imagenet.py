@@ -1,0 +1,113 @@
+"""Data-parallel ResNet-50 training (reference: examples/nn/imagenet.py,
+410 LoC of torch DataLoader + DataParallel wiring).
+
+The reference trains ResNet-50 on ImageNet under ``mpirun`` with
+per-parameter gradient hooks.  Here the batch is sharded over the device
+mesh and the whole iteration is one compiled step.  ImageNet itself is not
+bundled; by default the example runs on synthetic ImageNet-shaped batches —
+point ``--data`` at a directory of HDF5 shards (images/labels datasets) to
+train on real data via the streaming loader.
+
+    python examples/nn/imagenet.py [--epochs 2] [--batch-size 128]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import jax.numpy as jnp
+import optax
+
+import heat_tpu as ht
+
+
+def synthetic_batches(batch_size, image_size, classes, steps, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        X = rng.standard_normal(
+            (batch_size, image_size, image_size, 3), dtype=np.float32
+        )
+        y = rng.integers(0, classes, batch_size)
+        yield X, y
+
+
+def hdf5_batches(path, batch_size):
+    """Stream (images, labels) slabs from an HDF5 file with the out-of-core
+    loader; slabs arrive as DNDarrays already sharded over the mesh."""
+    from heat_tpu.utils.data import PartialH5Dataset
+
+    ds = PartialH5Dataset(
+        path, dataset_names=["images", "labels"], initial_load=batch_size
+    )
+    yield from ds
+
+
+def hdf5_rows(path):
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        return f["images"].shape[0]
+
+
+def main():
+    parser = argparse.ArgumentParser(description="heat_tpu ImageNet example")
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--steps-per-epoch", type=int, default=16)
+    parser.add_argument("--image-size", type=int, default=176)
+    parser.add_argument("--classes", type=int, default=1000)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--bf16", action="store_true", help="bfloat16 compute")
+    parser.add_argument("--data", type=str, default=None, help="HDF5 shard path")
+    args = parser.parse_args()
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    # the reference steps a StepLR scheduler every 30 epochs; here the
+    # schedule is baked into the optimizer, so step_size must reflect the
+    # real steps per epoch (file rows for HDF5 data)
+    steps_per_epoch = (
+        -(-hdf5_rows(args.data) // args.batch_size)
+        if args.data
+        else args.steps_per_epoch
+    )
+    schedule = ht.optim.lr_scheduler.StepLR(
+        args.lr, step_size=30 * steps_per_epoch, gamma=0.1
+    )
+    model = ht.nn.DataParallel(
+        ht.models.ResNet50(num_classes=args.classes, dtype=dtype),
+        optimizer=ht.optim.DataParallelOptimizer(
+            optax.sgd(schedule, momentum=0.9, nesterov=True)
+        ),
+    )
+    shape = (8, args.image_size, args.image_size, 3)
+    model.init(0, np.zeros(shape, np.float32))
+
+    for epoch in range(args.epochs):
+        batches = (
+            hdf5_batches(args.data, args.batch_size)
+            if args.data
+            else synthetic_batches(
+                args.batch_size, args.image_size, args.classes,
+                args.steps_per_epoch, seed=epoch,
+            )
+        )
+        t0, losses, n_images = time.perf_counter(), [], 0
+        for X, y in batches:
+            if not isinstance(X, ht.DNDarray):
+                X, y = ht.array(X, split=0), ht.array(y, split=0)
+            n_images += X.shape[0]
+            losses.append(model.train_step(X, y))
+        dt = time.perf_counter() - t0
+        if not losses:
+            print(f"epoch {epoch}: no batches")
+            continue
+        mean_loss = float(sum(float(l) for l in losses) / len(losses))
+        print(
+            f"epoch {epoch}: loss {mean_loss:.4f}  "
+            f"{n_images / dt:.0f} img/s ({len(losses)} steps, {dt:.1f}s)"
+        )
+
+
+if __name__ == "__main__":
+    main()
