@@ -5,8 +5,8 @@ The reference trains ResNet-50 on ImageNet under ``mpirun`` with
 per-parameter gradient hooks.  Here the batch is sharded over the device
 mesh and the whole iteration is one compiled step.  ImageNet itself is not
 bundled; by default the example runs on synthetic ImageNet-shaped batches —
-point ``--data`` at a directory of HDF5 shards (images/labels datasets) to
-train on real data via the streaming loader.
+point ``--data`` at an HDF5 file (images/labels datasets) to train on real
+data via the streaming loader.
 
     python examples/nn/imagenet.py [--epochs 2] [--batch-size 128]
 """

@@ -2,10 +2,10 @@
 
 from .mlp import MLP
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
-from .transformer import TransformerLM, TransformerBlock
+from .transformer import TransformerLM, TransformerBlock, MoEMlp
 
 __all__ = [
     "MLP",
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
-    "TransformerLM", "TransformerBlock",
+    "TransformerLM", "TransformerBlock", "MoEMlp",
 ]

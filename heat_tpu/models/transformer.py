@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-__all__ = ["TransformerLM", "TransformerBlock"]
+__all__ = ["TransformerLM", "TransformerBlock", "MoEMlp"]
 
 
 class MultiHeadAttention(nn.Module):
@@ -58,6 +58,41 @@ class MultiHeadAttention(nn.Module):
         return nn.DenseGeneral(x.shape[-1], axis=-1, use_bias=False, name="out")(out)
 
 
+class MoEMlp(nn.Module):
+    """Mixture-of-experts FFN (expert-parallel over ``ep_mesh``'s
+    ``ep_axis`` when given; dense single-device path otherwise).
+
+    The router's load-balancing loss is sowed under
+    ``intermediates/moe_aux_loss`` — pull it out with
+    ``model.apply(vars, x, mutable=["intermediates"])`` and add
+    ``alpha * sum(losses)`` to the training objective.
+    """
+
+    num_experts: int
+    hidden: int
+    k: int = 2
+    capacity_factor: float = 2.0
+    ep_mesh: Optional[object] = None
+    ep_axis: str = "ep"
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.expert import moe_ffn
+
+        d = x.shape[-1]
+        init = nn.initializers.lecun_normal()
+        gate_w = self.param("gate", init, (d, self.num_experts))
+        w_in = self.param("w_in", init, (self.num_experts, d, self.hidden))
+        w_out = self.param("w_out", init, (self.num_experts, self.hidden, d))
+        y, aux = moe_ffn(
+            x, gate_w, w_in, w_out,
+            k=self.k, capacity_factor=self.capacity_factor,
+            mesh=self.ep_mesh, axis=self.ep_axis,
+        )
+        self.sow("intermediates", "moe_aux_loss", aux["load_balance_loss"])
+        return y
+
+
 class TransformerBlock(nn.Module):
     num_heads: int
     head_dim: int
@@ -65,6 +100,11 @@ class TransformerBlock(nn.Module):
     attention: str = "flash"
     sp_mesh: Optional[object] = None
     sp_axis: str = "sp"
+    moe_experts: int = 0  # 0 = dense MLP; >0 = MoE FFN with this many experts
+    moe_k: int = 2
+    moe_capacity_factor: float = 2.0
+    ep_mesh: Optional[object] = None
+    ep_axis: str = "ep"
 
     @nn.compact
     def __call__(self, x):
@@ -76,9 +116,16 @@ class TransformerBlock(nn.Module):
         )(y)
         y = nn.LayerNorm(use_bias=False)(x)
         hidden = x.shape[-1] * self.mlp_ratio
-        y = nn.Dense(hidden, use_bias=False, name="mlp_in")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1], use_bias=False, name="mlp_out")(y)
+        if self.moe_experts:
+            y = MoEMlp(
+                self.moe_experts, hidden, k=self.moe_k,
+                capacity_factor=self.moe_capacity_factor,
+                ep_mesh=self.ep_mesh, ep_axis=self.ep_axis, name="moe",
+            )(y)
+        else:
+            y = nn.Dense(hidden, use_bias=False, name="mlp_in")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(x.shape[-1], use_bias=False, name="mlp_out")(y)
         return x + y
 
 
@@ -98,6 +145,11 @@ class TransformerLM(nn.Module):
     attention: str = "flash"
     sp_mesh: Optional[object] = None
     sp_axis: str = "sp"
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 2.0
+    ep_mesh: Optional[object] = None
+    ep_axis: str = "ep"
     remat: bool = False
 
     @nn.compact
@@ -115,6 +167,9 @@ class TransformerLM(nn.Module):
             x = block(
                 self.num_heads, self.head_dim, self.mlp_ratio,
                 attention=self.attention, sp_mesh=self.sp_mesh, sp_axis=self.sp_axis,
+                moe_experts=self.moe_experts, moe_k=self.moe_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                ep_mesh=self.ep_mesh, ep_axis=self.ep_axis,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(use_bias=False, name="final_norm")(x)

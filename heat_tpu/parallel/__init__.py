@@ -22,6 +22,8 @@ from .mesh import (
 from . import collectives
 from . import pipeline
 from .pipeline import pipeline_apply, stack_stage_params
+from . import expert
+from .expert import moe_ffn
 
 __all__ = [
     "Communication",
@@ -35,4 +37,6 @@ __all__ = [
     "pipeline",
     "pipeline_apply",
     "stack_stage_params",
+    "expert",
+    "moe_ffn",
 ]
