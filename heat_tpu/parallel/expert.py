@@ -16,9 +16,10 @@ supplies the capability TPU-first, completing the parallelism matrix
   path), and the inverse ``all_to_all`` + weighted combine back.
 
 Everything is shape-static so the whole step jits into a single XLA
-program; the two all-to-alls ride ICI.  ``mesh=None`` runs the identical
-math on one device (the single-chip path and the correctness oracle for
-the sharded one).
+program; the two all-to-alls ride ICI.  ``mesh=None`` runs the same
+routing on one device; since capacity and drop priority are enforced per
+shard, the two paths agree exactly only while nothing is dropped
+(``fraction_dropped == 0`` — the regime training aims for).
 """
 
 from __future__ import annotations
@@ -162,7 +163,8 @@ def moe_ffn(
         k: experts per token.
         capacity_factor: headroom over perfectly-balanced expert load.
         mesh: expert-parallel mesh; ``None`` = single-device dense path
-            (identical math, no collectives).
+            (no collectives; matches the sharded path exactly while
+            ``fraction_dropped == 0`` — capacity is per shard).
         axis: mesh axis name carrying both tokens and experts.
 
     Returns:

@@ -1,0 +1,278 @@
+"""Failure detection and elastic restart-from-checkpoint (SURVEY.md §5).
+
+The reference has no failure handling at all — "an MPI abort kills the job"
+(SURVEY.md §5, failure detection row); its only recovery primitive is array
+save/load.  This module supplies the subsystem TPU-first, building on the
+sharded checkpoints of :mod:`heat_tpu.utils.checkpointing`:
+
+* :func:`run_elastic` — a supervised training loop: every step's result is
+  health-checked (non-finite loss/metrics count as failures, exceptions are
+  caught), failures trigger a restore of the latest checkpoint and a rerun;
+  deterministically-poisoned steps (a bad batch that fails again after
+  restore) are skipped rather than retried forever; a restart budget bounds
+  the total recovery work.
+* :class:`StallDetector` — a wall-clock watchdog thread: if no heartbeat
+  arrives within ``timeout`` seconds (a hung collective, a wedged host), a
+  stall event fires.  XLA's static schedule removes data races, but a lost
+  peer still hangs a collective forever — detection has to live on the host
+  clock.
+* :class:`FaultInjector` — deterministic fault injection for testing the
+  above: raise at step N, or corrupt the loss to NaN at step N.  The test
+  doctrine stays the reference's "no mocks" (SURVEY.md §4): injected faults
+  run through the real restore path on the real mesh.
+
+Multi-host note: each host runs the same supervised loop SPMD-style; a
+restore after a full-job restart resumes from the same sharded checkpoint
+(``jax.distributed.initialize`` re-forms the mesh first).  In-place slice
+shrink/grow is not attempted — XLA programs are compiled for a fixed mesh;
+elasticity is restart-from-checkpoint onto the new mesh, which
+:func:`heat_tpu.utils.checkpointing.load_checkpoint` supports via
+``target`` shardings.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "ElasticFailure",
+    "FaultInjector",
+    "StallDetector",
+    "default_health_check",
+    "run_elastic",
+]
+
+
+class ElasticFailure(RuntimeError):
+    """Raised when recovery is exhausted (restart budget spent)."""
+
+
+class FaultInjector:
+    """Deterministic fault injection for exercising the recovery path.
+
+    >>> faults = FaultInjector().raise_at(5).nan_at(9)
+    >>> loss = faults.fire(step, loss)   # call inside the step
+
+    ``raise_at`` throws ``InjectedFault`` when the step executes;
+    ``nan_at`` returns the loss corrupted to NaN instead.  Each fault
+    fires once ("transient") unless ``sticky=True`` ("deterministic" —
+    e.g. a poisoned batch that fails on every retry).
+    """
+
+    class InjectedFault(RuntimeError):
+        pass
+
+    def __init__(self):
+        self._raises: Dict[int, bool] = {}
+        self._nans: Dict[int, bool] = {}
+
+    def raise_at(self, step: int, *, sticky: bool = False) -> "FaultInjector":
+        self._raises[int(step)] = sticky
+        return self
+
+    def nan_at(self, step: int, *, sticky: bool = False) -> "FaultInjector":
+        self._nans[int(step)] = sticky
+        return self
+
+    def fire(self, step: int, loss):
+        step = int(step)
+        if step in self._raises:
+            if not self._raises[step]:
+                del self._raises[step]
+            raise FaultInjector.InjectedFault(f"injected fault at step {step}")
+        if step in self._nans:
+            if not self._nans[step]:
+                del self._nans[step]
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(x, dtype=np.float32) * np.nan, loss
+            )
+        return loss
+
+
+class StallDetector:
+    """Host-clock watchdog: fires ``on_stall`` if :meth:`beat` goes quiet.
+
+    >>> watchdog = StallDetector(timeout=300, on_stall=callback)
+    >>> watchdog.start()
+    >>> for batch in data:
+    ...     watchdog.beat()   # after each completed step
+    >>> watchdog.stop()
+
+    The callback runs on the watchdog thread; it should record/alert and
+    leave process teardown to the supervisor (killing a wedged XLA
+    collective from inside the process is not recoverable anyway).
+    """
+
+    def __init__(self, timeout: float, on_stall: Callable[[float], None]):
+        self.timeout = float(timeout)
+        self.on_stall = on_stall
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StallDetector":
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self._fired = False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout + 1.0)
+
+    def _watch(self) -> None:
+        poll = min(0.05, self.timeout / 4)
+        while not self._stop.wait(poll):
+            quiet = time.monotonic() - self._last
+            if quiet > self.timeout and not self._fired:
+                self._fired = True  # once per stall, not once per poll
+                self.on_stall(quiet)
+
+
+def default_health_check(metrics: Any) -> bool:
+    """Healthy iff every array/scalar leaf of ``metrics`` is finite."""
+    for leaf in jax.tree_util.tree_leaves(metrics):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            return False
+    return True
+
+
+@dataclass
+class ElasticReport:
+    """What happened during a :func:`run_elastic` run."""
+
+    steps_run: int = 0
+    restarts: int = 0
+    skipped_steps: List[int] = field(default_factory=list)
+    events: List[Dict[str, Any]] = field(default_factory=list)
+
+    def record(self, kind: str, **info) -> None:
+        self.events.append({"kind": kind, **info})
+
+
+def run_elastic(
+    step_fn: Callable[[Any, Any], tuple],
+    init_state: Any,
+    batch_fn: Callable[[int], Any],
+    n_steps: int,
+    *,
+    checkpointer=None,
+    checkpoint_every: int = 50,
+    max_restarts: int = 3,
+    health_check: Callable[[Any], bool] = default_health_check,
+    on_event: Optional[Callable[[Dict[str, Any]], None]] = None,
+):
+    """Run ``n_steps`` of training under failure supervision.
+
+    Args:
+        step_fn: ``(state, batch) -> (state, metrics)``; exceptions and
+            non-finite metrics are treated as step failures.
+        init_state: starting state (any pytree the checkpointer can save).
+        batch_fn: ``step -> batch``; called once per attempted step, so
+            data order is reproducible across restarts.
+        n_steps: total steps to run.
+        checkpointer: a :class:`heat_tpu.utils.checkpointing.Checkpointer`;
+            ``None`` recovers by rewinding to ``init_state`` (step 0).
+        checkpoint_every: save cadence in steps (ignored without a
+            checkpointer).
+        max_restarts: recovery budget; exceeding it raises
+            :class:`ElasticFailure` carrying the report so far.
+        health_check: predicate on the step's metrics; default = all
+            float leaves finite.
+        on_event: optional callback receiving each event dict as it is
+            recorded (for logging/alerting).
+
+    Returns:
+        ``(state, report)`` — the final state and an :class:`ElasticReport`.
+
+    A step that fails twice at the same index (fails again immediately
+    after its restore) is deterministic — retrying cannot help, so the
+    step is skipped and recorded in ``report.skipped_steps`` (the
+    batch's contribution is lost; the alternative is an unbounded crash
+    loop).
+    """
+
+    def emit(report: ElasticReport, kind: str, **info) -> None:
+        report.record(kind, **info)
+        if on_event is not None:
+            on_event(report.events[-1])
+
+    report = ElasticReport()
+    state = init_state
+    step = 0
+    last_saved = None
+    last_failed_step = None
+
+    if checkpointer is not None:
+        restored = checkpointer.restore_latest(target={"state": init_state, "step": 0})
+        if restored is not None:
+            state, step = restored["state"], int(restored["step"])
+            last_saved = step
+            emit(report, "resume", step=step)
+
+    while step < n_steps:
+        if step in report.skipped_steps:
+            step += 1
+            continue
+        try:
+            new_state, metrics = step_fn(state, batch_fn(step))
+            # surface device-side NaN/Inf (and deferred XLA errors) now,
+            # while recovery is still possible
+            jax.block_until_ready(metrics)
+            if not health_check(metrics):
+                raise _UnhealthyStep(f"health check failed at step {step}")
+        except Exception as exc:  # noqa: BLE001 — any step failure recovers
+            if report.restarts >= max_restarts:
+                emit(report, "give_up", step=step, error=repr(exc))
+                raise ElasticFailure(
+                    f"restart budget ({max_restarts}) exhausted at step {step}: {exc!r}"
+                ) from exc
+            report.restarts += 1
+            if step == last_failed_step:
+                # failed, restored, failed again at the same step: the
+                # fault is deterministic in the (state, batch) pair — skip
+                report.skipped_steps.append(step)
+                emit(report, "skip", step=step, error=repr(exc))
+            else:
+                emit(report, "failure", step=step, error=repr(exc))
+            last_failed_step = step
+            if checkpointer is not None and last_saved is not None:
+                restored = checkpointer.restore_latest(
+                    target={"state": init_state, "step": 0}
+                )
+                state, step = restored["state"], int(restored["step"])
+                emit(report, "restore", step=step)
+            else:
+                state, step = init_state, 0
+                emit(report, "rewind", step=0)
+            continue
+
+        state = new_state
+        step += 1
+        report.steps_run += 1
+        if (
+            checkpointer is not None
+            and checkpoint_every > 0
+            and step % checkpoint_every == 0
+        ):
+            checkpointer.save(step, {"state": state, "step": step})
+            last_saved = step
+
+    return state, report
+
+
+class _UnhealthyStep(RuntimeError):
+    pass

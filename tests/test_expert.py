@@ -176,7 +176,7 @@ class TestMoETransformer(TestCase):
         )
         toks = jnp.array(np.random.default_rng(0).integers(0, 64, (2, 16)))
         variables = lm.init(jax.random.PRNGKey(0), toks)
-        logits, state = lm.apply(toks_v := variables, toks, mutable=["intermediates"])
+        logits, state = lm.apply(variables, toks, mutable=["intermediates"])
         self.assertEqual(logits.shape, (2, 16, 64))
         self.assertTrue(np.isfinite(np.asarray(logits)).all())
         aux = [
