@@ -1,0 +1,96 @@
+"""Fault-tolerant training with elastic restart (no reference counterpart —
+the reference's failure story is "an MPI abort kills the job", SURVEY.md §5).
+
+Trains a small model under the ``run_elastic`` supervisor: checkpoints are
+written every few steps, a fault is injected mid-run (a NaN batch and a
+crash), and training recovers from the latest sharded checkpoint instead of
+dying.  Re-running the script resumes where the previous run stopped — the
+full-job-restart story.
+
+    python examples/nn/elastic.py [--steps N] [--ckpt-dir DIR]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser(description="heat_tpu elastic training example")
+    parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument("--ckpt-dir", type=str, default="/tmp/heat_tpu_elastic_ckpt")
+    parser.add_argument("--checkpoint-every", type=int, default=10)
+    parser.add_argument("--inject", action="store_true", default=True,
+                        help="inject a NaN batch at step 17 and a crash at step 23")
+    parser.add_argument("--no-inject", dest="inject", action="store_false")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import heat_tpu as ht
+    from heat_tpu.utils import Checkpointer, FaultInjector, StallDetector, run_elastic
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((256, 16)), jnp.float32)
+    W_true = jnp.asarray(rng.standard_normal((16, 1)), jnp.float32)
+    Y = X @ W_true + 0.01 * jnp.asarray(rng.standard_normal((256, 1)), jnp.float32)
+
+    model = ht.models.MLP(features=(64, 1))
+    params = model.init(jax.random.PRNGKey(0), X)
+    tx = optax.adam(1e-2)
+
+    @jax.jit
+    def train_step(state, batch):
+        p, o = state
+        x, y = batch
+
+        def loss_fn(p):
+            return jnp.mean((model.apply(p, x) - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        upd, o = tx.update(grads, o, p)
+        return (optax.apply_updates(p, upd), o), {"loss": loss}
+
+    faults = FaultInjector()
+    if args.inject:
+        faults.nan_at(17).raise_at(23)
+
+    def step_fn(state, step):
+        state, metrics = train_step(state, (X, Y))
+        metrics["loss"] = faults.fire(step, metrics["loss"])
+        return state, metrics
+
+    watchdog = StallDetector(
+        timeout=120.0,
+        on_stall=lambda quiet: print(f"!! no step completed for {quiet:.0f}s"),
+    ).start()
+
+    t0 = time.perf_counter()
+    try:
+        state, report = run_elastic(
+            step_fn,
+            (params, tx.init(params)),
+            lambda step: step,
+            n_steps=args.steps,
+            checkpointer=Checkpointer(args.ckpt_dir, max_to_keep=2),
+            checkpoint_every=args.checkpoint_every,
+            on_event=lambda event: print(f"  [elastic] {event}"),
+            on_step=lambda step, metrics: watchdog.beat(),
+        )
+    finally:
+        watchdog.stop()
+
+    final_loss = float(train_step(state, (X, Y))[1]["loss"])
+    print(
+        f"{report.steps_run} steps ({report.restarts} restarts, "
+        f"{len(report.skipped_steps)} skipped) in {time.perf_counter()-t0:.1f}s; "
+        f"final loss {final_loss:.5f}"
+    )
+    assert np.isfinite(final_loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Mixture-of-experts language-model training (no reference counterpart —
+the reference has no sequence models or MoE at all, SURVEY.md §2.5/§5).
+
+Trains the decoder-only ``TransformerLM`` with an expert-parallel MoE FFN
+on a synthetic token stream: experts and tokens are sharded over an ``ep``
+mesh axis, dispatch/return ride two ``all_to_all``s, and the Switch
+load-balancing loss (sowed by the MoE layer) is added to the objective so
+the router learns to spread load.
+
+    python examples/nn/moe_lm.py [--steps N] [--experts E] [--top-k K]
+
+Runs on whatever devices are present: one TPU chip (dense expert compute,
+same math) or a forced multi-device CPU mesh for the expert-parallel path:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/nn/moe_lm.py --force-cpu
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser(description="heat_tpu MoE LM example")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--experts", type=int, default=8)
+    parser.add_argument("--top-k", type=int, default=2)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--vocab", type=int, default=256)
+    parser.add_argument("--aux-weight", type=float, default=0.01)
+    parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument(
+        "--force-cpu", action="store_true",
+        help="force the CPU backend (pair with xla_force_host_platform_device_count)",
+    )
+    args = parser.parse_args()
+
+    if args.force_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    import heat_tpu as ht
+
+    n_dev = len(jax.devices())
+    ep_mesh = Mesh(np.array(jax.devices()), ("ep",)) if n_dev > 1 else None
+    if ep_mesh is not None and args.experts % n_dev:
+        args.experts = max(n_dev, args.experts - args.experts % n_dev)
+    print(f"devices: {n_dev} ({jax.devices()[0].platform}), "
+          f"experts: {args.experts}, expert-parallel: {ep_mesh is not None}")
+
+    model = ht.models.TransformerLM(
+        vocab_size=args.vocab,
+        num_layers=args.layers,
+        num_heads=4,
+        head_dim=32,
+        max_seq_len=args.seq_len,
+        moe_experts=args.experts,
+        moe_k=args.top_k,
+        ep_mesh=ep_mesh,
+    )
+
+    # synthetic data: patterned token stream the LM can actually learn.
+    # The whole pool is staged onto the device up front — feeding a batch
+    # per step from the host would put the host→device round trip on the
+    # critical path (docs/PERFORMANCE.md, device-resident rule).
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, args.vocab, args.seq_len + 1)
+    pool = 16
+    offs = rng.integers(0, args.vocab, (pool, args.batch_size, 1))
+    toks = jnp.asarray((base[None, None, :] + offs) % args.vocab)
+
+    def batch_fn(step):
+        b = toks[step % pool]
+        return b[:, :-1], b[:, 1:]
+
+    params = model.init(jax.random.PRNGKey(0), batch_fn(0)[0])
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    print(f"parameters: {n_params/1e6:.2f}M")
+    tx = optax.adamw(args.lr)
+    opt = tx.init(params)
+
+    def loss_fn(p, x, y):
+        logits, state = model.apply(p, x, mutable=["intermediates"])
+        lp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.mean(jnp.take_along_axis(lp, y[..., None], -1))
+        aux = sum(jnp.asarray(v).sum() for v in jax.tree.leaves(state["intermediates"]))
+        return nll + args.aux_weight * aux, nll
+
+    @jax.jit
+    def train_step(p, o, x, y):
+        (_, nll), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, x, y)
+        upd, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, upd), o, nll
+
+    t0 = time.perf_counter()
+    nll = None
+    for step in range(args.steps):
+        x, y = batch_fn(step)
+        params, opt, nll = train_step(params, opt, x, y)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  nll {float(nll):.4f}")
+    wall = time.perf_counter() - t0
+    toks = args.steps * args.batch_size * args.seq_len
+    print(f"{args.steps} steps in {wall:.1f}s — {toks/wall:.0f} tokens/s")
+    assert np.isfinite(float(nll))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,8 +12,12 @@ supplies the capability TPU-first, completing the parallelism matrix
 * dispatch is the GShard/Switch schedule: top-k routing with a static
   per-expert capacity, one ``all_to_all`` to move token slabs to their
   experts' devices, the expert FFN as one batched einsum over the local
-  experts (MXU-friendly: static shapes, no gather/scatter in the hot
-  path), and the inverse ``all_to_all`` + weighted combine back.
+  experts, and the inverse ``all_to_all`` + weighted combine back.
+  Token→slot movement is a scatter-add / gather pair — O(tokens·k·d)
+  HBM traffic — not GShard's dense one-hot dispatch einsum, whose
+  O(tokens·experts·capacity·d) FLOPs dwarf the expert GEMMs themselves
+  at transformer sizes (measured 4.5x slower end-to-end on one v5e;
+  docs/PERFORMANCE.md).
 
 Everything is shape-static so the whole step jits into a single XLA
 program; the two all-to-alls ride ICI.  ``mesh=None`` runs the same
@@ -51,20 +55,12 @@ def expert_capacity(
     return max(cap, 1)
 
 
-def top_k_routing(gate_logits: jax.Array, k: int, capacity: int):
-    """Top-k token→expert assignment with capacity-limited positions.
+def _route(gate_logits: jax.Array, k: int, capacity: int):
+    """Top-k token→expert assignment with capacity-limited slot positions.
 
-    Args:
-        gate_logits: (t, E) router scores for the shard's tokens.
-        k: experts per token.
-        capacity: max tokens an expert accepts from this shard.
-
-    Returns:
-        dispatch: (t, E, C) one-hot dispatch tensor (float32).
-        combine: (t, E, C) dispatch scaled by the token's normalized
-            top-k router weight.
-        aux: dict with ``load_balance_loss`` (the Switch auxiliary loss
-            for this shard) and ``fraction_dropped``.
+    Returns ``(top_w, top_idx, pos_in_expert, kept, aux)`` — each of the
+    first four is (t, k); ``aux`` holds the Switch load-balancing loss and
+    the dropped fraction for this shard.
 
     Position assignment is token-major: when an expert oversubscribes,
     earlier tokens win — the same deterministic priority for any mesh
@@ -83,16 +79,6 @@ def top_k_routing(gate_logits: jax.Array, k: int, capacity: int):
     pos_in_expert = jnp.sum(position, axis=-1).reshape(t, k)  # (t, k)
     kept = pos_in_expert < capacity
 
-    dispatch = (
-        jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)[..., None]
-        * jax.nn.one_hot(jnp.minimum(pos_in_expert, capacity - 1), capacity)[
-            :, :, None, :
-        ]
-        * kept[..., None, None]
-    )  # (t, k, E, C)
-    combine = jnp.sum(dispatch * top_w[..., None, None], axis=1)  # (t, E, C)
-    dispatch = jnp.sum(dispatch, axis=1)  # (t, E, C)
-
     # Switch-style auxiliary load-balancing loss: E * sum_e f_e * p_e where
     # f_e is the fraction of routed choices sent to expert e and p_e the
     # mean router probability of e over the shard's tokens.
@@ -102,6 +88,28 @@ def top_k_routing(gate_logits: jax.Array, k: int, capacity: int):
         "load_balance_loss": num_experts * jnp.sum(f * p),
         "fraction_dropped": 1.0 - jnp.mean(kept.astype(jnp.float32)),
     }
+    return top_w, top_idx, pos_in_expert, kept, aux
+
+
+def top_k_routing(gate_logits: jax.Array, k: int, capacity: int):
+    """GShard-style dense routing tensors (reference formulation, kept for
+    inspection/debugging; the hot path uses the scatter/gather form).
+
+    Returns ``(dispatch, combine, aux)``: dispatch (t, E, C) one-hot,
+    combine = dispatch scaled by the normalized top-k router weight, and
+    the aux dict of :func:`_route`.
+    """
+    num_experts = gate_logits.shape[1]
+    top_w, top_idx, pos_in_expert, kept, aux = _route(gate_logits, k, capacity)
+    dispatch = (
+        jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)[..., None]
+        * jax.nn.one_hot(jnp.minimum(pos_in_expert, capacity - 1), capacity)[
+            :, :, None, :
+        ]
+        * kept[..., None, None]
+    )  # (t, k, E, C)
+    combine = jnp.sum(dispatch * top_w[..., None, None], axis=1)  # (t, E, C)
+    dispatch = jnp.sum(dispatch, axis=1)  # (t, E, C)
     return dispatch, combine, aux
 
 
@@ -118,10 +126,23 @@ def _moe_shard(
 ):
     """One shard's MoE FFN. ``x`` (t, d); ``w_in`` (E_local, d, h),
     ``w_out`` (E_local, h, d); ``gate_w`` (d, E_global) replicated."""
-    dispatch, combine, aux = top_k_routing(x @ gate_w, k, capacity)
+    t, d = x.shape
+    num_experts = gate_w.shape[1]
+    top_w, top_idx, pos_in_expert, kept, aux = _route(x @ gate_w, k, capacity)
 
-    # token slabs per expert: (E, C, d) — one einsum, no scatters
-    expert_inputs = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # token→slot scatter: each kept (token, choice) lands in flat slot
+    # e*C + pos; dropped choices land in a trash slot that is sliced off.
+    # O(t·k·d) HBM traffic vs the dense dispatch einsum's O(t·E·C·d) FLOPs.
+    n_slots = num_experts * capacity
+    # dropped choices get index n_slots — out of bounds, discarded by
+    # mode="drop"; the in-bounds (kept) indices are unique by construction
+    # (each expert slot is assigned at most once)
+    dest = jnp.where(kept, top_idx * capacity + pos_in_expert, n_slots)  # (t, k)
+    src = jnp.broadcast_to(x[:, None, :], (t, k, d)).reshape(t * k, d)
+    # (no unique_indices hint: every dropped choice shares the sentinel
+    # index, which would violate the uniqueness contract)
+    slots = jnp.zeros((n_slots, d), x.dtype).at[dest.reshape(-1)].add(src, mode="drop")
+    expert_inputs = slots.reshape(num_experts, capacity, d)
     if axis is not None:
         # exchange slabs so each device holds ALL shards' tokens for its
         # resident experts: (E, C, d) -> (E/N, N*C, d)
@@ -135,7 +156,13 @@ def _moe_shard(
         expert_outputs = all_to_all(expert_outputs, axis, split_axis=1, concat_axis=0)
         aux = {key: psum(val, axis) / axis_size(axis) for key, val in aux.items()}
 
-    y = jnp.einsum("tec,ecd->td", combine, expert_outputs)
+    # slot→token gather + weighted combine; the trash row returns zeros
+    # for dropped choices (they pass through the residual unchanged)
+    out_flat = jnp.concatenate(
+        [expert_outputs.reshape(n_slots, d), jnp.zeros((1, d), expert_outputs.dtype)]
+    )
+    gathered = out_flat[dest.reshape(-1)].reshape(t, k, d)
+    y = jnp.sum(gathered * top_w[..., None].astype(gathered.dtype), axis=1)
     return y.astype(x.dtype), aux
 
 

@@ -174,6 +174,7 @@ def run_elastic(
     max_restarts: int = 3,
     health_check: Callable[[Any], bool] = default_health_check,
     on_event: Optional[Callable[[Dict[str, Any]], None]] = None,
+    on_step: Optional[Callable[[int, Any], None]] = None,
 ):
     """Run ``n_steps`` of training under failure supervision.
 
@@ -194,15 +195,19 @@ def run_elastic(
             float leaves finite.
         on_event: optional callback receiving each event dict as it is
             recorded (for logging/alerting).
+        on_step: optional callback ``(step, metrics)`` after each
+            *successful* step — the place to beat a
+            :class:`StallDetector` or log progress.
 
     Returns:
         ``(state, report)`` — the final state and an :class:`ElasticReport`.
 
     A step that fails twice at the same index (fails again immediately
     after its restore) is deterministic — retrying cannot help, so the
-    step is skipped and recorded in ``report.skipped_steps`` (the
-    batch's contribution is lost; the alternative is an unbounded crash
-    loop).
+    step is skipped and recorded in ``report.skipped_steps`` (the batch's
+    contribution is lost; the alternative is an unbounded crash loop).
+    The skip happens in place — the pre-step state is intact, so no
+    restore is needed and the restart budget is not charged again.
     """
 
     def emit(report: ElasticReport, kind: str, **info) -> None:
@@ -235,19 +240,23 @@ def run_elastic(
             if not health_check(metrics):
                 raise _UnhealthyStep(f"health check failed at step {step}")
         except Exception as exc:  # noqa: BLE001 — any step failure recovers
+            if step == last_failed_step:
+                # failed, restored, failed again at the same step: the
+                # fault is deterministic in the (state, batch) pair — skip
+                # it in place (the pre-step state is intact; no restore,
+                # no extra budget charge)
+                report.skipped_steps.append(step)
+                emit(report, "skip", step=step, error=repr(exc))
+                last_failed_step = None
+                step += 1
+                continue
             if report.restarts >= max_restarts:
                 emit(report, "give_up", step=step, error=repr(exc))
                 raise ElasticFailure(
                     f"restart budget ({max_restarts}) exhausted at step {step}: {exc!r}"
                 ) from exc
             report.restarts += 1
-            if step == last_failed_step:
-                # failed, restored, failed again at the same step: the
-                # fault is deterministic in the (state, batch) pair — skip
-                report.skipped_steps.append(step)
-                emit(report, "skip", step=step, error=repr(exc))
-            else:
-                emit(report, "failure", step=step, error=repr(exc))
+            emit(report, "failure", step=step, error=repr(exc))
             last_failed_step = step
             if checkpointer is not None and last_saved is not None:
                 restored = checkpointer.restore_latest(
@@ -263,6 +272,8 @@ def run_elastic(
         state = new_state
         step += 1
         report.steps_run += 1
+        if on_step is not None:
+            on_step(step, metrics)
         if (
             checkpointer is not None
             and checkpoint_every > 0

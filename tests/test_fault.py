@@ -96,6 +96,23 @@ class TestRunElastic(TestCase):
         )
         self.assertEqual(state, sum(range(8)) - 4)  # batch 4's update lost
         self.assertEqual(report.skipped_steps, [4])
+        # one restart for the first failure; the skip itself is free (the
+        # pre-step state was intact, no restore needed)
+        self.assertEqual(report.restarts, 1)
+        kinds = [e["kind"] for e in report.events]
+        self.assertEqual(kinds, ["failure", "rewind", "skip"])
+
+    def test_two_poisoned_steps_fit_a_small_budget(self):
+        """Each poisoned step costs one restart, so two sticky faults
+        survive max_restarts=2 (skips are free)."""
+        from heat_tpu.utils.fault import FaultInjector, run_elastic
+
+        faults = FaultInjector().raise_at(5, sticky=True).raise_at(9, sticky=True)
+        state, report = run_elastic(
+            _counting_step(faults), 0.0, lambda s: s, n_steps=12, max_restarts=2
+        )
+        self.assertEqual(state, sum(range(12)) - 5 - 9)
+        self.assertEqual(report.skipped_steps, [5, 9])
         self.assertEqual(report.restarts, 2)
 
     def test_restart_budget_exhausted_raises(self):
@@ -137,11 +154,15 @@ class TestRunElastic(TestCase):
         from heat_tpu.utils.fault import FaultInjector, run_elastic
 
         seen = []
+        steps_seen = []
         run_elastic(
             _counting_step(FaultInjector().raise_at(2)),
             0.0, lambda s: s, n_steps=4, on_event=seen.append,
+            on_step=lambda step, metrics: steps_seen.append(step),
         )
         self.assertEqual([e["kind"] for e in seen], ["failure", "rewind"])
+        # on_step fires per successful step (incl. the post-rewind replay)
+        self.assertEqual(steps_seen, [1, 2] + [1, 2, 3, 4])
 
     def test_elastic_training_real_model(self):
         """End-to-end: a jitted flax train step under supervision, NaN
