@@ -18,6 +18,8 @@ from .mesh import (
     sanitize_comm,
     world,
     local_mesh,
+    init_distributed,
+    hybrid_mesh,
 )
 from . import collectives
 from . import pipeline
@@ -33,6 +35,8 @@ __all__ = [
     "sanitize_comm",
     "world",
     "local_mesh",
+    "init_distributed",
+    "hybrid_mesh",
     "collectives",
     "pipeline",
     "pipeline_apply",

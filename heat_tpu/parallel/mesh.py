@@ -17,8 +17,9 @@ primitives around torch tensors, here a :class:`MeshComm` wraps a
   all-to-all / collective-permute on ICI automatically.
 
 Multi-host initialization (the reference's ``mpirun`` bootstrap,
-communication.py:1909-1921) maps to ``jax.distributed.initialize()`` which the
-user calls once before building a mesh.
+communication.py:1909-1921) maps to :func:`init_distributed` — call it once
+before building a mesh; :func:`hybrid_mesh` then lays DCN-spanning axes over
+slices/hosts and ICI axes within a slice.
 """
 
 from __future__ import annotations
@@ -39,6 +40,8 @@ __all__ = [
     "sanitize_comm",
     "world",
     "local_mesh",
+    "init_distributed",
+    "hybrid_mesh",
 ]
 
 #: canonical name of the mesh axis that backs the DNDarray ``split`` dimension
@@ -242,3 +245,113 @@ def local_mesh(n: Optional[int] = None, axis: str = SPLIT_AXIS) -> MeshComm:
     if n is not None:
         devices = devices[:n]
     return MeshComm(Mesh(np.array(devices), (axis,)), split_axis=axis)
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> Tuple[int, int]:
+    """Multi-host bootstrap (the reference's ``mpirun`` + import-time
+    ``MPI_WORLD`` creation, heat/core/communication.py:1909-1921).
+
+    Wraps ``jax.distributed.initialize`` so user scripts stay launcher
+    agnostic:
+
+    * already initialized → no-op;
+    * explicit arguments → passed straight through (errors propagate: the
+      caller asked for a specific topology and should hear when it fails);
+    * no arguments → delegate to JAX's own cluster auto-detection (Slurm,
+      Open MPI, GCE TPU metadata, GKE env, ``JAX_COORDINATOR_ADDRESS``);
+      when no cluster is detectable — a plain single-process run — this is
+      a clean no-op rather than an error.
+
+    Call it before any other JAX usage (backend initialization pins the
+    process topology); called later in a single-process program it simply
+    no-ops.  Returns ``(process_index, process_count)`` — the reference's
+    ``(rank, size)``.
+    """
+    already = False
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # pragma: no cover - older jax
+        from jax._src import distributed as _dist
+
+        already = getattr(_dist.global_state, "client", None) is not None
+    if not already:
+        explicit = (
+            coordinator_address is not None
+            or num_processes is not None
+            or process_id is not None
+            or bool(kwargs)
+        )
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        else:
+            try:
+                from jax._src import xla_bridge as _xla_bridge
+
+                backend_up = _xla_bridge.backends_are_initialized()
+            except (ImportError, AttributeError):  # pragma: no cover
+                backend_up = True  # conservatively skip auto-init
+            if not backend_up:
+                try:
+                    # jax's ClusterEnv chain detects Slurm/MPI/GCE/GKE and
+                    # reads JAX_COORDINATOR_ADDRESS itself
+                    jax.distributed.initialize()
+                except (ValueError, RuntimeError):
+                    pass  # no cluster detected: single-process run
+    return jax.process_index(), jax.process_count()
+
+
+def hybrid_mesh(
+    ici: dict, dcn: Optional[dict] = None, *, process_is_granule: bool = False
+) -> Mesh:
+    """Build a DCN × ICI device mesh (the reference's two-tier topology —
+    NCCL inside a node, MPI across, heat/optim/dp_optimizer.py:46 — expressed
+    as mesh axes).
+
+    Args:
+        ici: ordered ``{axis_name: size}`` for axes riding intra-slice ICI
+            links (fast: tensor/sequence/expert parallelism belong here).
+        dcn: ordered ``{axis_name: size}`` for axes spanning the slow outer
+            network (data parallelism, DASO's outer tier). Sizes of 1 are
+            allowed and make the result a plain single-slice mesh.
+        process_is_granule: what the dcn tier spans. ``False`` (default):
+            TPU slices (`slice_index`) — multi-slice pods over DCN.
+            ``True``: host processes — e.g. the hosts of one TPU slice, or
+            any multi-host cluster whose devices carry no slice topology.
+
+    Returns a ``jax.sharding.Mesh`` with dcn axes leading (slowest-varying),
+    so collectives along ici axes never cross a granule boundary.
+
+    >>> mesh = hybrid_mesh({"split": 4}, {"dp": 2})   # 2 slices x 4 chips
+    >>> MeshComm(mesh)                                 # split rides ICI
+    """
+    from jax.experimental import mesh_utils
+
+    dcn = dict(dcn or {})
+    ici = dict(ici)
+    if not ici:
+        raise ValueError("ici must name at least one mesh axis")
+    names = tuple(dcn) + tuple(ici)
+    dcn_shape = tuple(dcn.values())
+    ici_shape = tuple(ici.values())
+    n_dcn = int(np.prod(dcn_shape)) if dcn_shape else 1
+    if n_dcn > 1:
+        # create_hybrid_device_mesh wants rank-aligned shapes: dcn axes are
+        # size 1 in the inner (ICI) shape and vice versa
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1,) * len(dcn_shape) + ici_shape,
+            dcn_mesh_shape=dcn_shape + (1,) * len(ici_shape),
+            process_is_granule=process_is_granule,
+        )
+        return Mesh(devices, names)
+    devices = mesh_utils.create_device_mesh(ici_shape)
+    return Mesh(devices.reshape(dcn_shape + ici_shape), names)

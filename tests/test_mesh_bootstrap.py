@@ -1,0 +1,60 @@
+"""Multi-host bootstrap helpers (heat_tpu/parallel/mesh.py).
+
+``init_distributed`` is the reference's ``mpirun`` + import-time MPI_WORLD
+creation (heat/core/communication.py:1909-1921); single-process it must be
+a clean no-op.  ``hybrid_mesh`` is the two-tier NCCL-in-node/MPI-across
+topology of DASO (heat/optim/dp_optimizer.py:46) as mesh axes.
+"""
+
+from .base import TestCase
+
+
+class TestInitDistributed(TestCase):
+    def test_single_process_noop(self):
+        from heat_tpu.parallel import init_distributed
+
+        rank, size = init_distributed()
+        self.assertEqual((rank, size), (0, 1))
+
+    def test_idempotent(self):
+        from heat_tpu.parallel import init_distributed
+
+        self.assertEqual(init_distributed(), init_distributed())
+
+
+class TestHybridMesh(TestCase):
+    def test_ici_only(self):
+        from heat_tpu.parallel import hybrid_mesh
+
+        mesh = hybrid_mesh({"split": 4, "tp": 2})
+        self.assertEqual(mesh.axis_names, ("split", "tp"))
+        self.assertEqual(dict(mesh.shape), {"split": 4, "tp": 2})
+
+    def test_unit_dcn_axis_is_plain_mesh(self):
+        """dcn sizes of 1 (single slice) keep the axis for spec
+        compatibility without needing slice topology info."""
+        from heat_tpu.parallel import hybrid_mesh
+
+        mesh = hybrid_mesh({"split": 8}, {"dp": 1})
+        self.assertEqual(mesh.axis_names, ("dp", "split"))
+        self.assertEqual(dict(mesh.shape), {"dp": 1, "split": 8})
+
+    def test_mesh_drives_sharded_compute(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from heat_tpu.parallel import hybrid_mesh
+
+        mesh = hybrid_mesh({"split": 4, "tp": 2}, {"dp": 1})
+        x = jax.device_put(
+            jnp.arange(64.0).reshape(8, 8),
+            NamedSharding(mesh, P(("dp", "split"), "tp")),
+        )
+        self.assertAlmostEqual(float(jnp.sum(x * 2)), 2 * 63 * 64 / 2)
+
+    def test_empty_ici_rejected(self):
+        from heat_tpu.parallel import hybrid_mesh
+
+        with self.assertRaises(ValueError):
+            hybrid_mesh({})
