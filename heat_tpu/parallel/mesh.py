@@ -305,9 +305,48 @@ def init_distributed(
                     # jax's ClusterEnv chain detects Slurm/MPI/GCE/GKE and
                     # reads JAX_COORDINATOR_ADDRESS itself
                     jax.distributed.initialize()
-                except (ValueError, RuntimeError):
-                    pass  # no cluster detected: single-process run
+                except (ValueError, RuntimeError) as exc:
+                    # "no cluster detected" is a clean single-process no-op;
+                    # a cluster that WAS detected but failed to come up must
+                    # fail loudly — silently degrading to N independent
+                    # rank-0 jobs corrupts results
+                    if _looks_multiprocess():
+                        raise RuntimeError(
+                            "a multi-process launcher environment was "
+                            "detected but jax.distributed.initialize() "
+                            f"failed: {exc}"
+                        ) from exc
+            elif _looks_multiprocess():
+                import warnings
+
+                warnings.warn(
+                    "init_distributed() was called after the JAX backend was "
+                    "initialized; multi-host setup was skipped although a "
+                    "multi-process launcher environment is present. Call "
+                    "init_distributed() before any other JAX usage.",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
     return jax.process_index(), jax.process_count()
+
+
+def _looks_multiprocess() -> bool:
+    """Cheap launcher-env sniff: does this look like one process of many?"""
+
+    def _int(name: str) -> int:
+        try:
+            return int(os.environ.get(name, "1"))
+        except ValueError:
+            return 1
+
+    tpu_workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return (
+        _int("SLURM_NTASKS") > 1
+        or _int("OMPI_COMM_WORLD_SIZE") > 1
+        or _int("PMI_SIZE") > 1
+        or bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
+        or len([w for w in tpu_workers.split(",") if w.strip()]) > 1
+    )
 
 
 def hybrid_mesh(
@@ -340,6 +379,10 @@ def hybrid_mesh(
     ici = dict(ici)
     if not ici:
         raise ValueError("ici must name at least one mesh axis")
+    if set(dcn) & set(ici):
+        raise ValueError(
+            f"axis names must be distinct across tiers: {sorted(set(dcn) & set(ici))}"
+        )
     names = tuple(dcn) + tuple(ici)
     dcn_shape = tuple(dcn.values())
     ici_shape = tuple(ici.values())

@@ -1,0 +1,173 @@
+"""API-parity audit: every public name the reference exports, checked
+against this package.
+
+The reference's user namespace is flat: ``heat/__init__.py`` star-imports
+``core`` and ``core.linalg`` and registers every subpackage, so ``ht.*`` is
+the union of the core modules' ``__all__`` lists plus the subpackage
+namespaces (SURVEY.md §1).  The reference cannot be imported here (it needs
+mpi4py), so its ``__all__`` lists are read statically with ``ast``.
+
+Usage:
+    python scripts/parity_audit.py [--write docs/PARITY.md]
+
+Exit status is the number of missing names — 0 means full surface parity.
+tests/test_parity_audit.py runs this as a regression gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Set
+
+REFERENCE = os.environ.get("HEAT_REFERENCE_PATH", "/root/reference")
+
+# reference modules whose __all__ lands in the flat ht.* namespace
+# (heat/core/__init__.py star-imports each; heat/__init__.py star-imports
+# core and core.linalg)
+CORE_MODULES = [
+    "heat/core/arithmetics.py",
+    "heat/core/base.py",
+    "heat/core/communication.py",
+    "heat/core/complex_math.py",
+    "heat/core/constants.py",
+    "heat/core/devices.py",
+    "heat/core/exponential.py",
+    "heat/core/factories.py",
+    "heat/core/indexing.py",
+    "heat/core/io.py",
+    "heat/core/logical.py",
+    "heat/core/manipulations.py",
+    "heat/core/memory.py",
+    "heat/core/printing.py",
+    "heat/core/relational.py",
+    "heat/core/rounding.py",
+    "heat/core/sanitation.py",
+    "heat/core/signal.py",
+    "heat/core/statistics.py",
+    "heat/core/tiling.py",
+    "heat/core/trigonometrics.py",
+    "heat/core/types.py",
+    "heat/core/version.py",
+    "heat/core/dndarray.py",
+    # linalg/__init__.py star-imports basics, solver, qr only (svd's names
+    # are NOT in the reference's public namespace — it is an empty stub)
+    "heat/core/linalg/basics.py",
+    "heat/core/linalg/qr.py",
+    "heat/core/linalg/solver.py",
+]
+
+# names imported into the flat namespace explicitly, outside any __all__
+# (heat/core/__init__.py: `from .types import finfo, iinfo`)
+EXTRA_FLAT = ["finfo", "iinfo"]
+
+# subpackages / module namespaces checked as ht.<pkg>.<name>
+# (heat/core/__init__.py does `from . import random` — module, not star;
+# stride_tricks is not imported into the public namespace at all)
+SUBPACKAGES = {
+    "random": ["heat/core/random.py"],
+    "cluster": ["heat/cluster/kmeans.py", "heat/cluster/kmedians.py",
+                "heat/cluster/kmedoids.py", "heat/cluster/spectral.py"],
+    "classification": ["heat/classification/kneighborsclassifier.py"],
+    "graph": ["heat/graph/laplacian.py"],
+    "naive_bayes": ["heat/naive_bayes/gaussianNB.py"],
+    "regression": ["heat/regression/lasso.py"],
+    "spatial": ["heat/spatial/distance.py"],
+    "sparse": ["heat/sparse/dcsr_matrix.py", "heat/sparse/factories.py",
+               "heat/sparse/manipulations.py"],
+    "nn": ["heat/nn/data_parallel.py"],
+    "optim": ["heat/optim/dp_optimizer.py", "heat/optim/utils.py"],
+    "utils.data": ["heat/utils/data/datatools.py", "heat/utils/data/mnist.py",
+                   "heat/utils/data/partial_dataset.py"],
+}
+
+
+def module_all(path: str) -> List[str]:
+    """Statically read a module's ``__all__`` (list/tuple of str literals);
+    modules without one (the estimator files) fall back to their public
+    top-level class names — exactly what their package ``__init__`` pulls."""
+    full = os.path.join(REFERENCE, path)
+    if not os.path.exists(full):
+        return []
+    tree = ast.parse(open(full, encoding="utf-8").read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    try:
+                        return [str(v) for v in ast.literal_eval(node.value)]
+                    except (ValueError, SyntaxError):
+                        return []
+    return [
+        node.name
+        for node in tree.body
+        if isinstance(node, ast.ClassDef) and not node.name.startswith("_")
+    ]
+
+
+def collect_reference() -> Dict[str, Set[str]]:
+    """{namespace: names} — '' is the flat top level."""
+    spaces: Dict[str, Set[str]] = {"": set(EXTRA_FLAT)}
+    for mod in CORE_MODULES:
+        spaces[""].update(module_all(mod))
+    for pkg, files in SUBPACKAGES.items():
+        spaces[pkg] = set()
+        for mod in files:
+            spaces[pkg].update(module_all(mod))
+    return spaces
+
+
+def audit():
+    import heat_tpu as ht
+
+    spaces = collect_reference()
+    present: Dict[str, List[str]] = {}
+    missing: Dict[str, List[str]] = {}
+    for space, names in sorted(spaces.items()):
+        target = ht
+        for part in filter(None, space.split(".")):
+            target = getattr(target, part, None)
+        for name in sorted(names):
+            ok = target is not None and hasattr(target, name)
+            (present if ok else missing).setdefault(space, []).append(name)
+    return present, missing
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--write", metavar="PATH", default=None)
+    args = parser.parse_args()
+
+    present, missing = audit()
+    n_present = sum(len(v) for v in present.values())
+    n_missing = sum(len(v) for v in missing.values())
+    lines = [
+        "# API parity audit",
+        "",
+        f"Reference public names (static `__all__` scan of `{REFERENCE}`):",
+        f"**{n_present + n_missing}** — present here: **{n_present}**, "
+        f"missing: **{n_missing}**.",
+        "",
+        "Regenerate: `python scripts/parity_audit.py --write docs/PARITY.md`",
+        "(gated by tests/test_parity_audit.py).",
+        "",
+    ]
+    for space in sorted(set(present) | set(missing)):
+        label = "ht" if space == "" else f"ht.{space}"
+        lines.append(
+            f"- `{label}`: {len(present.get(space, []))} present"
+            + (f", missing: {', '.join('`%s`' % n for n in missing[space])}"
+               if space in missing else "")
+        )
+    report = "\n".join(lines) + "\n"
+    if args.write:
+        with open(args.write, "w", encoding="utf-8") as f:
+            f.write(report)
+    print(report)
+    return n_missing
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,3 +58,9 @@ class TestHybridMesh(TestCase):
 
         with self.assertRaises(ValueError):
             hybrid_mesh({})
+
+    def test_duplicate_axis_across_tiers_rejected(self):
+        from heat_tpu.parallel import hybrid_mesh
+
+        with self.assertRaises(ValueError):
+            hybrid_mesh({"dp": 8}, {"dp": 1})
