@@ -11,3 +11,5 @@ TSQR_M, TSQR_N = (1_000_000, 128) if ON_TPU else (20_000, 64)
 CLUSTER_N = 250_000 if ON_TPU else 5_000
 RESHAPE_SIZES = [10_000, 20_000, 40_000] if ON_TPU else [1_000, 2_000]
 CONCAT_N = 1_000_000 if ON_TPU else 50_000
+ATTN_BH, ATTN_S, ATTN_D = (16, 4096, 128) if ON_TPU else (4, 256, 32)
+MOE_T, MOE_D, MOE_H = (16_384, 1024, 4096) if ON_TPU else (512, 64, 128)

@@ -7,6 +7,7 @@ import sys
 import linalg
 import cluster
 import manipulations
+import nn
 
 from heat_tpu.utils import monitor as _monitor
 
@@ -14,5 +15,6 @@ if __name__ == "__main__":
     linalg.run()
     cluster.run()
     manipulations.run()
+    nn.run()
     print(json.dumps({"suite": "cb", "measurements": _monitor.measurements()}))
     sys.exit(0)

@@ -1,0 +1,57 @@
+# Continuous-benchmark NN-kernel workloads (no reference counterpart — the
+# reference's cb suite has no attention or MoE; these cover the kernels this
+# framework adds: flash attention and the expert-parallel MoE FFN).
+#
+# Data is generated in run() so the monitored region times the kernel, not
+# host-side RNG + transfer (the cluster.py pattern).
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from heat_tpu.utils.monitor import monitor
+
+import config
+
+
+@monitor()
+def flash_attention_forward(q):
+    from heat_tpu.ops.attention import flash_attention
+
+    return jax.block_until_ready(flash_attention(q, q, q, causal=True))
+
+
+@monitor()
+def moe_ffn_forward(x, gate, w_in, w_out):
+    from heat_tpu.parallel.expert import moe_ffn
+
+    # jit so the step compiles to the single fused program the module is
+    # designed around (the mesh=None path does not jit internally)
+    @functools.partial(jax.jit)
+    def step(x, gate, w_in, w_out):
+        y, _ = moe_ffn(x, gate, w_in, w_out, k=2)
+        return y
+
+    return jax.block_until_ready(step(x, gate, w_in, w_out))
+
+
+def run():
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if config.ON_TPU else jnp.float32
+
+    bh, s, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
+    q = jnp.asarray(rng.standard_normal((bh, s, d)), dt)
+    flash_attention_forward(q)
+
+    t, dm, h = config.MOE_T, config.MOE_D, config.MOE_H
+    x = jnp.asarray(rng.standard_normal((t, dm)), dt)
+    gate = jnp.asarray(rng.standard_normal((dm, 8)), dt)
+    w_in = jnp.asarray(rng.standard_normal((8, dm, h)) / 32, dt)
+    w_out = jnp.asarray(rng.standard_normal((8, h, dm)) / 32, dt)
+    moe_ffn_forward(x, gate, w_in, w_out)
+
+
+if __name__ == "__main__":
+    run()

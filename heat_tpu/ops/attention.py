@@ -85,9 +85,11 @@ def _flash_kernel(
     jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
 )
 def _flash_pallas(q, k, v, causal, scale, block_q=512, block_k=2048, interpret=False):
-    # block defaults from a sweep on v5e at s=4096, d=128: (512, 2048) hits
-    # 78 TFLOP/s vs 14 at (128, 128) — the (bq, bk) score tile must be large
-    # enough to amortize the per-block softmax bookkeeping on the VPU
+    # block defaults from sweeps on v5e at s=4096, d=128: (512, 2048) hits
+    # ~126 TFLOP/s non-causal / ~73 effective causal (docs/PERFORMANCE.md);
+    # the (bq, bk) score tile must be large enough to amortize the per-block
+    # softmax bookkeeping on the VPU, and beats finer blocks even causal
+    # where finer granularity would skip more masked work
     bh, sq, d = q.shape
     _, sk, _ = k.shape
     bq = min(block_q, max(8, sq))
