@@ -2,10 +2,9 @@
 # reference's cb suite has no attention or MoE; these cover the kernels this
 # framework adds: flash attention and the expert-parallel MoE FFN).
 #
-# Data is generated in run() so the monitored region times the kernel, not
-# host-side RNG + transfer (the cluster.py pattern).
-import functools
-
+# Data is generated in run() and each kernel is warmed (compiled) before the
+# monitored call, so the monitored region times the kernel — not host RNG,
+# transfer, or XLA compilation (the cluster.py pattern, plus warmup).
 import numpy as np
 
 import jax
@@ -16,25 +15,28 @@ from heat_tpu.utils.monitor import monitor
 import config
 
 
-@monitor()
-def flash_attention_forward(q):
+def _attention_step(q):
     from heat_tpu.ops.attention import flash_attention
 
-    return jax.block_until_ready(flash_attention(q, q, q, causal=True))
+    return flash_attention(q, q, q, causal=True)
+
+
+@jax.jit
+def _moe_step(x, gate, w_in, w_out):
+    from heat_tpu.parallel.expert import moe_ffn
+
+    y, _ = moe_ffn(x, gate, w_in, w_out, k=2)
+    return y
+
+
+@monitor()
+def flash_attention_forward(q):
+    return jax.block_until_ready(_attention_step(q))
 
 
 @monitor()
 def moe_ffn_forward(x, gate, w_in, w_out):
-    from heat_tpu.parallel.expert import moe_ffn
-
-    # jit so the step compiles to the single fused program the module is
-    # designed around (the mesh=None path does not jit internally)
-    @functools.partial(jax.jit)
-    def step(x, gate, w_in, w_out):
-        y, _ = moe_ffn(x, gate, w_in, w_out, k=2)
-        return y
-
-    return jax.block_until_ready(step(x, gate, w_in, w_out))
+    return jax.block_until_ready(_moe_step(x, gate, w_in, w_out))
 
 
 def run():
@@ -43,6 +45,7 @@ def run():
 
     bh, s, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
     q = jnp.asarray(rng.standard_normal((bh, s, d)), dt)
+    jax.block_until_ready(_attention_step(q))  # warmup: compile
     flash_attention_forward(q)
 
     t, dm, h = config.MOE_T, config.MOE_D, config.MOE_H
@@ -50,6 +53,7 @@ def run():
     gate = jnp.asarray(rng.standard_normal((dm, 8)), dt)
     w_in = jnp.asarray(rng.standard_normal((8, dm, h)) / 32, dt)
     w_out = jnp.asarray(rng.standard_normal((8, h, dm)) / 32, dt)
+    jax.block_until_ready(_moe_step(x, gate, w_in, w_out))  # warmup: compile
     moe_ffn_forward(x, gate, w_in, w_out)
 
 

@@ -1,0 +1,163 @@
+"""Global indexing oracle sweep (reference: dndarray.py:779-1035 getitem,
+:1498-1788 setitem — SURVEY.md §7 ranks this hard part #1).
+
+Table-driven: every key class the reference documents (ints, slices with
+steps, negative indices, ellipsis, newaxis, int arrays, boolean masks,
+mixed basic/advanced) is applied to odd-shaped arrays for every split and
+compared element-for-element against the NumPy oracle, global result and
+per-shard layout both (assert_array_equal re-derives each device's slice
+via comm.chunk, the reference's own test oracle, SURVEY.md §4).
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+# keys exercised on a (7, 5) 2-D array
+KEYS_2D = [
+    2,
+    -1,
+    (3, 4),
+    (-2, -3),
+    slice(None),
+    slice(1, 6),
+    slice(None, None, 2),
+    slice(5, 1, -1),
+    slice(-2, None),
+    (slice(1, 6), 2),
+    (2, slice(1, 4)),
+    (slice(1, 6, 2), slice(0, 4, 3)),
+    Ellipsis,
+    (Ellipsis, 1),
+    (1, Ellipsis),
+    (slice(2, 5), Ellipsis),
+    None,
+    (None, 2),
+    (slice(1, 4), None),
+    (None, slice(2, 6), None, 1),
+    np.array([0, 2, 6]),
+    np.array([[0, 1], [5, 6]]),
+    np.array([True, False, True, False, True, False, True]),
+    (np.array([1, 3]), slice(1, 4)),
+    (slice(None), np.array([0, 4])),
+]
+
+# keys exercised on a (5, 4, 3) 3-D array
+KEYS_3D = [
+    (1, 2, 0),
+    (slice(1, 4), 2),
+    (slice(None), slice(None), 1),
+    (2, slice(None), slice(0, 2)),
+    (Ellipsis, 0),
+    (slice(0, 4, 2), Ellipsis, slice(None, None, 2)),
+    np.array([0, 4, 2]),
+    (slice(None), np.array([0, 3])),
+]
+
+
+class TestGetitemSweep(TestCase):
+    def _sweep(self, data, keys):
+        for split in [None] + list(range(data.ndim)):
+            x = ht.array(data, split=split)
+            for key in keys:
+                expected = data[key]
+                got = x[key]
+                if np.ndim(expected) == 0:
+                    self.assertAlmostEqual(
+                        float(got), float(expected), msg=f"split={split} key={key!r}"
+                    )
+                else:
+                    try:
+                        self.assert_array_equal(got, expected)
+                    except AssertionError as exc:
+                        raise AssertionError(f"split={split} key={key!r}: {exc}")
+
+    def test_2d(self):
+        self._sweep(np.arange(35, dtype=np.float32).reshape(7, 5), KEYS_2D)
+
+    def test_3d(self):
+        self._sweep(np.arange(60, dtype=np.float32).reshape(5, 4, 3), KEYS_3D)
+
+    def test_1d_including_empty_result(self):
+        data = np.arange(13, dtype=np.float32)  # 13/8 devices: uneven + empty shards
+        keys = [0, -1, slice(2, 11, 3), slice(None, None, -1), slice(5, 5),
+                np.array([12, 0, 7]), data > 100]
+        self._sweep(data, keys)
+
+    def test_boolean_mask_of_full_ndim(self):
+        data = np.arange(24, dtype=np.float32).reshape(6, 4)
+        mask = (data % 3) == 0
+        for split in [None, 0, 1]:
+            x = ht.array(data, split=split)
+            got = x[ht.array(mask, split=split)]
+            np.testing.assert_array_equal(np.sort(got.numpy()), np.sort(data[mask]))
+
+    def test_split_metadata(self):
+        """The documented split-inference contract: slices keep the split
+        (shifted by dropped/new axes); an int at the split axis gathers."""
+        x = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=0)
+        self.assertEqual(x[1:5].split, 0)
+        self.assertIsNone(x[2].split)  # split dim consumed
+        self.assertEqual(x[None, 1:5].split, 1)  # newaxis shifts it
+        y = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=1)
+        self.assertEqual(y[2].split, 0)  # dim 0 dropped: split 1 -> 0
+        self.assertEqual(y[1:5].split, 1)  # untouched
+
+
+class TestSetitemSweep(TestCase):
+    SET_CASES = [
+        (2, 7.0),
+        (-1, 3.5),
+        ((3, 4), -1.0),
+        (slice(1, 4), 2.0),
+        (slice(None, None, 3), 4.0),
+        ((slice(2, 6), slice(1, 3)), 6.0),
+        ((slice(None), 2), 8.0),
+        (np.array([0, 5]), 9.0),
+    ]
+
+    def test_scalar_values(self):
+        base = np.arange(35, dtype=np.float32).reshape(7, 5)
+        for split in [None, 0, 1]:
+            for key, val in self.SET_CASES:
+                data = base.copy()
+                x = ht.array(data, split=split)
+                x[key] = val
+                data[key] = val
+                try:
+                    self.assert_array_equal(x, data)
+                except AssertionError as exc:
+                    raise AssertionError(f"split={split} key={key!r}: {exc}")
+
+    def test_array_values(self):
+        base = np.arange(35, dtype=np.float32).reshape(7, 5)
+        for split in [None, 0, 1]:
+            data = base.copy()
+            x = ht.array(data, split=split)
+            val = np.full((2, 5), -2.0, np.float32)
+            x[2:4] = val
+            data[2:4] = val
+            self.assert_array_equal(x, data)
+
+    def test_dndarray_values_cross_split(self):
+        """Assigning a DNDarray with a different split than the target."""
+        base = np.zeros((8, 4), np.float32)
+        val = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in [None, 0, 1]:
+            for vsplit in [None, 0, 1]:
+                data = base.copy()
+                x = ht.array(data, split=split)
+                x[2:5] = ht.array(val, split=vsplit)
+                data[2:5] = val
+                try:
+                    self.assert_array_equal(x, data)
+                except AssertionError as exc:
+                    raise AssertionError(f"split={split} vsplit={vsplit}: {exc}")
+
+    def test_setitem_preserves_dtype_and_split(self):
+        x = ht.array(np.arange(12).reshape(6, 2), dtype=ht.int32, split=0)
+        x[0] = 99
+        self.assertEqual(x.dtype, ht.int32)
+        self.assertEqual(x.split, 0)
+        self.assertEqual(int(x[0, 0]), 99)
