@@ -84,9 +84,9 @@ def bitwise_xor(t1, t2, out=None, where=None) -> DNDarray:
     return _operations._binary_op(jnp.bitwise_xor, t1, t2, out=out, where=where)
 
 
-def bitwise_not(t, out=None) -> DNDarray:
-    _check_int_or_bool(t)
-    return _operations._local_op(jnp.bitwise_not, t, out=out, no_cast=True)
+def bitwise_not(a, out=None) -> DNDarray:
+    _check_int_or_bool(a)
+    return _operations._local_op(jnp.bitwise_not, a, out=out, no_cast=True)
 
 
 invert = bitwise_not
@@ -109,13 +109,33 @@ def cumsum(a, axis: int, dtype=None, out=None) -> DNDarray:
     return _operations._cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype)
 
 
-def diff(a, n: int = 1, axis: int = -1) -> DNDarray:
-    """n-th discrete difference along ``axis`` (reference: arithmetics.py diff;
+def diff(a, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
+    """n-th discrete difference along ``axis`` (reference: arithmetics.py:293;
     there a halo exchange, here one sharded slice-subtract)."""
     from .stride_tricks import sanitize_axis
 
     axis = sanitize_axis(a.shape, axis)
-    result = jnp.diff(a.larray, n=n, axis=axis)
+
+    def as_local(v):
+        if v is None:
+            return None
+        if isinstance(v, DNDarray):
+            return v.larray
+        # no forced cast: np.diff upcasts (int array + 0.5 → float), so the
+        # usual promotion rules must apply here too
+        arr = jnp.asarray(v)
+        if arr.ndim == 0:  # scalars broadcast to one slice along axis
+            shape = list(a.shape)
+            shape[axis] = 1
+            arr = jnp.broadcast_to(arr, shape)
+        return arr
+
+    kw = {}
+    if prepend is not None:
+        kw["prepend"] = as_local(prepend)
+    if append is not None:
+        kw["append"] = as_local(append)
+    result = jnp.diff(a.larray, n=n, axis=axis, **kw)
     split = a.split
     out = DNDarray(
         result, tuple(result.shape), types.canonical_heat_type(result.dtype),
@@ -178,15 +198,15 @@ def nansum(a, axis=None, out=None, keepdims=False) -> DNDarray:
     return _operations._reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=keepdims)
 
 
-def neg(t, out=None) -> DNDarray:
-    return _operations._local_op(jnp.negative, t, out=out, no_cast=True)
+def neg(a, out=None) -> DNDarray:
+    return _operations._local_op(jnp.negative, a, out=out, no_cast=True)
 
 
 negative = neg
 
 
-def pos(t, out=None) -> DNDarray:
-    return _operations._local_op(jnp.positive, t, out=out, no_cast=True)
+def pos(a, out=None) -> DNDarray:
+    return _operations._local_op(jnp.positive, a, out=out, no_cast=True)
 
 
 positive = pos

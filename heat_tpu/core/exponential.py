@@ -39,12 +39,12 @@ def log1p(x, out=None) -> DNDarray:
     return _operations._local_op(jnp.log1p, x, out=out)
 
 
-def logaddexp(t1, t2, out=None, where=None) -> DNDarray:
-    return _operations._binary_op(jnp.logaddexp, t1, t2, out=out, where=where)
+def logaddexp(x1, x2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.logaddexp, x1, x2, out=out, where=where)
 
 
-def logaddexp2(t1, t2, out=None, where=None) -> DNDarray:
-    return _operations._binary_op(jnp.logaddexp2, t1, t2, out=out, where=where)
+def logaddexp2(x1, x2, out=None, where=None) -> DNDarray:
+    return _operations._binary_op(jnp.logaddexp2, x1, x2, out=out, where=where)
 
 
 def sqrt(x, out=None) -> DNDarray:

@@ -194,8 +194,12 @@ def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> 
     return __factory_like(a, dtype, split, empty, device, comm)
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order: str = "C") -> DNDarray:
     """2-D identity-like array (reference: factories.py:593)."""
+    if order not in ("C",):
+        # the reference only ever materializes C order; F-order layouts do
+        # not exist for jax.Arrays (XLA picks physical layout)
+        raise NotImplementedError("only C (row-major) order is supported")
     if isinstance(shape, (int, np.integer)):
         n, m = int(shape), int(shape)
     else:
@@ -297,17 +301,17 @@ def from_partitioned(x, comm=None) -> DNDarray:
     return from_partition_dict(parts, comm=comm)
 
 
-def from_partition_dict(parts: dict, comm=None) -> DNDarray:
+def from_partition_dict(parted: dict, comm=None) -> DNDarray:
     """Construct from a GAI partition dict (reference: factories.py:841)."""
-    shape = tuple(parts["shape"])
-    tiling = tuple(parts["partition_tiling"])
+    shape = tuple(parted["shape"])
+    tiling = tuple(parted["partition_tiling"])
     split_dims = [i for i, t in enumerate(tiling) if t > 1]
     split = split_dims[0] if split_dims else None
-    get = parts["get"]
+    get = parted["get"]
     chunks = []
-    keys = sorted(parts["partitions"].keys())
+    keys = sorted(parted["partitions"].keys())
     for key in keys:
-        p = parts["partitions"][key]
+        p = parted["partitions"][key]
         data = p["data"] if p.get("data") is not None else get(
             tuple(slice(s, s + l) for s, l in zip(p["start"], p["shape"]))
         )

@@ -215,13 +215,21 @@ def save_csv(
     header_lines=None,
     sep: str = ",",
     decimals: int = -1,
+    encoding: str = "utf-8",
+    comm=None,
+    truncate: bool = True,
     **kwargs,
 ) -> None:
-    """CSV save (reference: io.py:926)."""
+    """CSV save (reference: io.py:926).  ``comm`` is accepted for signature
+    parity (the write is host-side here); ``truncate=False`` appends."""
     arr = data.numpy()
     fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
-    header = "\n".join(header_lines) if header_lines else ""
-    np.savetxt(path, arr, delimiter=sep, fmt=fmt, header=header, comments="")
+    mode = "w" if truncate else "a"
+    # header only at the start of a file — appending must not repeat it
+    appending_to_content = mode == "a" and os.path.exists(path) and os.path.getsize(path) > 0
+    header = "\n".join(header_lines) if header_lines and not appending_to_content else ""
+    with open(path, mode, encoding=encoding, newline="") as fh:
+        np.savetxt(fh, arr, delimiter=sep, fmt=fmt, header=header, comments="")
 
 
 def load_npy(path: str, dtype=None, split: Optional[int] = None, device=None, comm=None) -> DNDarray:

@@ -276,13 +276,40 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis: int = -1, keepdims: bool = False) -
     return arithmetics.sum(mul, axis=axis, keepdims=keepdims)
 
 
-def cross(x1: DNDarray, x2: DNDarray, axis: int = -1) -> DNDarray:
-    """Cross product (reference: basics.py:47)."""
-    sanitation.sanitize_in(x1)
-    sanitation.sanitize_in(x2)
-    result = jnp.cross(x1.larray, x2.larray, axis=axis)
-    out = DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), x1.split, x1.device, x1.comm)
-    return _ensure_split(out, x1.split if x1.split is not None and x1.split < result.ndim else None)
+def cross(
+    a: DNDarray,
+    b: DNDarray,
+    axisa: int = -1,
+    axisb: int = -1,
+    axisc: int = -1,
+    axis: int = -1,
+) -> DNDarray:
+    """Cross product; 2-D vectors are promoted to 3-D (reference: basics.py:47).
+
+    ``axis`` overrides ``axisa``/``axisb``/``axisc`` when given (the NumPy
+    contract the reference follows)."""
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    if axis != -1:
+        axisa = axisb = axisc = axis
+    result = jnp.cross(a.larray, b.larray, axisa=axisa, axisb=axisb, axisc=axisc)
+
+    # track where a's split dimension lands: the vector axis (axisa) moves
+    # to axisc (or disappears for 2-vector inputs, where the output is the
+    # scalar z component); the other dims keep their relative order
+    new_split = None
+    if a.split is not None:
+        axisa_n = axisa % a.larray.ndim
+        if a.split != axisa_n:
+            remaining = [d for d in range(a.larray.ndim) if d != axisa_n]
+            pos = remaining.index(a.split)
+            if result.ndim == a.larray.ndim:  # vector axis kept, at axisc
+                axisc_n = axisc % result.ndim
+                new_split = pos if pos < axisc_n else pos + 1
+            else:  # 2-vector inputs: vector axis dropped entirely
+                new_split = pos
+    out = DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), new_split, a.device, a.comm)
+    return _ensure_split(out, new_split)
 
 
 # operator/method bindings

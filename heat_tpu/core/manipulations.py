@@ -72,12 +72,12 @@ def _wrap(arr, like: DNDarray, split) -> DNDarray:
     return _ensure_split(out, split)
 
 
-def balance(x: DNDarray, copy: bool = False) -> DNDarray:
+def balance(array: DNDarray, copy: bool = False) -> DNDarray:
     """Out-of-place balance (reference: manipulations.py:63). Always already
     balanced under GSPMD."""
     from .memory import copy as _copy
 
-    return _copy(x) if copy else x
+    return _copy(array) if copy else array
 
 
 def broadcast_arrays(*arrays: DNDarray) -> List[DNDarray]:
@@ -123,24 +123,24 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     return _wrap(result, ref, split)
 
 
-def diag(x: DNDarray, offset: int = 0) -> DNDarray:
+def diag(a: DNDarray, offset: int = 0) -> DNDarray:
     """Extract or construct a diagonal (reference: manipulations.py diag)."""
-    sanitation.sanitize_in(x)
-    if x.ndim == 1:
-        result = jnp.diag(x.larray, k=offset)
-        return _wrap(result, x, x.split)
-    return diagonal(x, offset=offset)
+    sanitation.sanitize_in(a)
+    if a.ndim == 1:
+        result = jnp.diag(a.larray, k=offset)
+        return _wrap(result, a, a.split)
+    return diagonal(a, offset=offset)
 
 
-def diagonal(x: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
+def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
     """Diagonal view (reference: manipulations.py diagonal)."""
-    sanitation.sanitize_in(x)
-    result = jnp.diagonal(x.larray, offset=offset, axis1=dim1, axis2=dim2)
-    split = None if x.split in (dim1, dim2) else x.split
+    sanitation.sanitize_in(a)
+    result = jnp.diagonal(a.larray, offset=offset, axis1=dim1, axis2=dim2)
+    split = None if a.split in (dim1, dim2) else a.split
     if split is not None:
         split -= sum(1 for d in (dim1, dim2) if d < split)
         split = min(split, result.ndim - 1)
-    return _wrap(result, x, split)
+    return _wrap(result, a, split)
 
 
 def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
@@ -148,38 +148,38 @@ def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
     return split(x, indices_or_sections, axis=2)
 
 
-def expand_dims(x: DNDarray, axis: int) -> DNDarray:
+def expand_dims(a: DNDarray, axis: int) -> DNDarray:
     """Insert a new axis (reference: manipulations.py expand_dims)."""
-    sanitation.sanitize_in(x)
-    axis = stride_tricks.sanitize_axis(tuple(x.shape) + (1,), axis)
-    result = jnp.expand_dims(x.larray, axis)
-    split = x.split
+    sanitation.sanitize_in(a)
+    axis = stride_tricks.sanitize_axis(tuple(a.shape) + (1,), axis)
+    result = jnp.expand_dims(a.larray, axis)
+    split = a.split
     if split is not None and split >= axis:
         split += 1
-    return _wrap(result, x, split)
+    return _wrap(result, a, split)
 
 
-def flatten(x: DNDarray) -> DNDarray:
+def flatten(a: DNDarray) -> DNDarray:
     """1-D copy (reference: manipulations.py flatten)."""
-    sanitation.sanitize_in(x)
-    result = x.larray.reshape(-1)
-    split = 0 if x.split is not None else None
-    return _wrap(result, x, split)
+    sanitation.sanitize_in(a)
+    result = a.larray.reshape(-1)
+    split = 0 if a.split is not None else None
+    return _wrap(result, a, split)
 
 
-def flip(x: DNDarray, axis=None) -> DNDarray:
+def flip(a: DNDarray, axis=None) -> DNDarray:
     """Reverse element order along axes (reference: manipulations.py flip)."""
-    sanitation.sanitize_in(x)
-    result = jnp.flip(x.larray, axis=axis)
-    return _wrap(result, x, x.split)
+    sanitation.sanitize_in(a)
+    result = jnp.flip(a.larray, axis=axis)
+    return _wrap(result, a, a.split)
 
 
-def fliplr(x: DNDarray) -> DNDarray:
-    return flip(x, 1)
+def fliplr(a: DNDarray) -> DNDarray:
+    return flip(a, 1)
 
 
-def flipud(x: DNDarray) -> DNDarray:
-    return flip(x, 0)
+def flipud(a: DNDarray) -> DNDarray:
+    return flip(a, 0)
 
 
 def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
@@ -212,67 +212,67 @@ def moveaxis(x: DNDarray, source, destination) -> DNDarray:
     return _wrap(result, x, split)
 
 
-def pad(x: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
+def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
     """Pad an array (reference: manipulations.py:1128)."""
-    sanitation.sanitize_in(x)
+    sanitation.sanitize_in(array)
     kwargs = {"constant_values": constant_values} if mode == "constant" else {}
-    result = jnp.pad(x.larray, pad_width, mode=mode, **kwargs)
-    return _wrap(result, x, x.split)
+    result = jnp.pad(array.larray, pad_width, mode=mode, **kwargs)
+    return _wrap(result, array, array.split)
 
 
-def ravel(x: DNDarray) -> DNDarray:
+def ravel(a: DNDarray) -> DNDarray:
     """Flatten (view when possible; reference: manipulations.py ravel)."""
-    return flatten(x)
+    return flatten(a)
 
 
-def redistribute(x: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
+def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
     """Out-of-place redistribute (reference: manipulations.py:1513)."""
     from .memory import copy as _copy
 
-    out = _copy(x)
+    out = _copy(arr)
     out.redistribute_(lshape_map=lshape_map, target_map=target_map)
     return out
 
 
-def repeat(x: DNDarray, repeats, axis=None) -> DNDarray:
+def repeat(a: DNDarray, repeats, axis=None) -> DNDarray:
     """Repeat elements (reference: manipulations.py:1570)."""
-    sanitation.sanitize_in(x)
+    sanitation.sanitize_in(a)
     r = repeats.larray if isinstance(repeats, DNDarray) else repeats
-    result = jnp.repeat(x.larray, r, axis=axis)
+    result = jnp.repeat(a.larray, r, axis=axis)
     # axis=None flattens: any distributed input ends up split along axis 0
-    split = 0 if (axis is None and x.split is not None) else x.split
-    return _wrap(result, x, split)
+    split = 0 if (axis is None and a.split is not None) else a.split
+    return _wrap(result, a, split)
 
 
-def reshape(x: DNDarray, *shape, new_split=None) -> DNDarray:
+def reshape(a: DNDarray, *shape, new_split=None) -> DNDarray:
     """Reshape (reference: manipulations.py:1821 — resplit-to-0 + Alltoallv
     there; one jnp.reshape with a target sharding here).  ``new_split`` sets
     the split of the result (defaults to the input's split when the dim count
     allows, else 0 for distributed inputs)."""
-    sanitation.sanitize_in(x)
+    sanitation.sanitize_in(a)
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
     shape = stride_tricks.sanitize_shape(shape, lval=-1)
-    result = jnp.reshape(x.larray, shape)
+    result = jnp.reshape(a.larray, shape)
     if new_split is None:
-        if x.split is None:
+        if a.split is None:
             new_split = None
-        elif x.split < result.ndim:
-            new_split = x.split
+        elif a.split < result.ndim:
+            new_split = a.split
         else:
             new_split = 0
-    return _wrap(result, x, new_split)
+    return _wrap(result, a, new_split)
 
 
-def resplit(x: DNDarray, axis: Optional[int] = None) -> DNDarray:
+def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     """Out-of-place re-partition (reference: manipulations.py:3325 — axis=None
     is an Allgatherv there; a device_put here either way)."""
-    sanitation.sanitize_in(x)
-    axis = stride_tricks.sanitize_axis(x.shape, axis)
-    if axis == x.split:
-        return x
-    arr = _to_physical(x.larray, x.shape, axis, x.comm)
-    return DNDarray(arr, x.shape, x.dtype, axis, x.device, x.comm)
+    sanitation.sanitize_in(arr)
+    axis = stride_tricks.sanitize_axis(arr.shape, axis)
+    if axis == arr.split:
+        return arr
+    physical = _to_physical(arr.larray, arr.shape, axis, arr.comm)
+    return DNDarray(physical, arr.shape, arr.dtype, axis, arr.device, arr.comm)
 
 
 def roll(x: DNDarray, shift, axis=None) -> DNDarray:
@@ -283,40 +283,40 @@ def roll(x: DNDarray, shift, axis=None) -> DNDarray:
     return _wrap(result, x, x.split)
 
 
-def rot90(x: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
+def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
     """Rotate in a plane (reference: manipulations.py rot90)."""
-    sanitation.sanitize_in(x)
-    result = jnp.rot90(x.larray, k=k, axes=axes)
-    split = x.split
+    sanitation.sanitize_in(m)
+    result = jnp.rot90(m.larray, k=k, axes=axes)
+    split = m.split
     if split is not None and k % 2 == 1:
-        a0, a1 = axes[0] % x.ndim, axes[1] % x.ndim
+        a0, a1 = axes[0] % m.ndim, axes[1] % m.ndim
         if split == a0:
             split = a1
         elif split == a1:
             split = a0
-    return _wrap(result, x, split)
+    return _wrap(result, m, split)
 
 
 def row_stack(arrays: Sequence[DNDarray]) -> DNDarray:
     return vstack(arrays)
 
 
-def shape(x: DNDarray) -> Tuple[int, ...]:
+def shape(a: DNDarray) -> Tuple[int, ...]:
     """Global shape (reference: manipulations.py shape)."""
-    return x.shape
+    return a.shape
 
 
-def sort(x: DNDarray, axis: int = -1, descending: bool = False, out=None):
+def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Sort along an axis; returns (sorted, original indices) like the
     reference (manipulations.py:2261 — a hand-written distributed sample sort
     there; XLA's partitioned sort here)."""
-    sanitation.sanitize_in(x)
-    axis = stride_tricks.sanitize_axis(x.shape, axis)
-    arr = x.larray
+    sanitation.sanitize_in(a)
+    axis = stride_tricks.sanitize_axis(a.shape, axis)
+    arr = a.larray
     indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
     values = jnp.take_along_axis(arr, indices, axis=axis)
-    v = _wrap(values, x, x.split)
-    i = _wrap(indices, x, x.split)
+    v = _wrap(values, a, a.split)
+    i = _wrap(indices, a, a.split)
     if out is not None:
         out.larray = v.larray
         return out, i

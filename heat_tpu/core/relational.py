@@ -10,56 +10,56 @@ from .dndarray import DNDarray
 __all__ = ["eq", "equal", "ge", "greater", "greater_equal", "gt", "le", "less", "less_equal", "lt", "ne", "not_equal"]
 
 
-def eq(t1, t2) -> DNDarray:
+def eq(x, y) -> DNDarray:
     """Elementwise ==."""
-    return _operations._binary_op(jnp.equal, t1, t2)
+    return _operations._binary_op(jnp.equal, x, y)
 
 
-def equal(t1, t2) -> bool:
+def equal(x, y) -> bool:
     """True iff shapes and all elements match (reference: global Allreduce of
     the local verdicts; here one jnp.all over the sharded comparison)."""
-    if isinstance(t1, DNDarray) and isinstance(t2, DNDarray):
-        if tuple(t1.shape) != tuple(t2.shape):
+    if isinstance(x, DNDarray) and isinstance(y, DNDarray):
+        if tuple(x.shape) != tuple(y.shape):
             return False
-        return bool(jnp.all(t1.larray == t2.larray))
-    a = t1.larray if isinstance(t1, DNDarray) else t1
-    b = t2.larray if isinstance(t2, DNDarray) else t2
+        return bool(jnp.all(x.larray == y.larray))
+    a = x.larray if isinstance(x, DNDarray) else x
+    b = y.larray if isinstance(y, DNDarray) else y
     try:
         return bool(jnp.all(jnp.equal(a, b)))
     except (ValueError, TypeError):
         return False
 
 
-def ge(t1, t2) -> DNDarray:
-    return _operations._binary_op(jnp.greater_equal, t1, t2)
+def ge(x, y) -> DNDarray:
+    return _operations._binary_op(jnp.greater_equal, x, y)
 
 
 greater_equal = ge
 
 
-def gt(t1, t2) -> DNDarray:
-    return _operations._binary_op(jnp.greater, t1, t2)
+def gt(x, y) -> DNDarray:
+    return _operations._binary_op(jnp.greater, x, y)
 
 
 greater = gt
 
 
-def le(t1, t2) -> DNDarray:
-    return _operations._binary_op(jnp.less_equal, t1, t2)
+def le(x, y) -> DNDarray:
+    return _operations._binary_op(jnp.less_equal, x, y)
 
 
 less_equal = le
 
 
-def lt(t1, t2) -> DNDarray:
-    return _operations._binary_op(jnp.less, t1, t2)
+def lt(x, y) -> DNDarray:
+    return _operations._binary_op(jnp.less, x, y)
 
 
 less = lt
 
 
-def ne(t1, t2) -> DNDarray:
-    return _operations._binary_op(jnp.not_equal, t1, t2)
+def ne(x, y) -> DNDarray:
+    return _operations._binary_op(jnp.not_equal, x, y)
 
 
 not_equal = ne

@@ -96,7 +96,7 @@ def bincount(x, weights=None, minlength: int = 0) -> DNDarray:
     return DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, x.device, x.comm)
 
 
-def bucketize(input, boundaries, right: bool = False, out=None) -> DNDarray:
+def bucketize(input, boundaries, out_int32: bool = False, right: bool = False, out=None) -> DNDarray:
     """Bucket index of each element (reference: statistics.py:394)."""
     sanitation.sanitize_in(input)
     b = boundaries.larray if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
@@ -104,6 +104,8 @@ def bucketize(input, boundaries, right: bool = False, out=None) -> DNDarray:
     # (= searchsorted side='left'); right=True → side='right'
     side = "right" if right else "left"
     result = jnp.searchsorted(b, input.larray, side=side)
+    if out_int32:
+        result = result.astype(jnp.int32)
     wrapped = _ensure_split(
         DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), input.split, input.device, input.comm),
         input.split,
@@ -150,9 +152,12 @@ def histc(input, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) 
     return wrapped
 
 
-def histogram(a, bins: int = 10, range=None, weights=None, density=None):
-    """NumPy-style histogram (reference: statistics.py:680)."""
+def histogram(a, bins: int = 10, range=None, normed=None, weights=None, density=None):
+    """NumPy-style histogram (reference: statistics.py:680; ``normed`` is the
+    deprecated pre-NumPy-1.24 alias the reference still accepts)."""
     sanitation.sanitize_in(a)
+    if normed is not None and density is None:
+        density = normed
     w = weights.larray if isinstance(weights, DNDarray) else weights
     hist, edges = jnp.histogram(a.larray, bins=bins, range=range, weights=w, density=density)
     h = DNDarray(hist, tuple(hist.shape), types.canonical_heat_type(hist.dtype), None, a.device, a.comm)

@@ -51,8 +51,8 @@ def arctan(x, out=None) -> DNDarray:
 atan = arctan
 
 
-def arctan2(t1, t2) -> DNDarray:
-    return _operations._binary_op(jnp.arctan2, t1, t2)
+def arctan2(x1, x2) -> DNDarray:
+    return _operations._binary_op(jnp.arctan2, x1, x2)
 
 
 atan2 = arctan2

@@ -119,6 +119,48 @@ def collect_reference() -> Dict[str, Set[str]]:
     return spaces
 
 
+def module_signatures(path: str, names: Set[str]) -> Dict[str, List[str]]:
+    """Statically read parameter-name lists of the reference's public
+    top-level functions in ``path`` (positional + keyword-only)."""
+    full = os.path.join(REFERENCE, path)
+    if not os.path.exists(full):
+        return {}
+    tree = ast.parse(open(full, encoding="utf-8").read())
+    sigs = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name in names:
+            sigs.setdefault(
+                node.name, [a.arg for a in node.args.args + node.args.kwonlyargs]
+            )
+    return sigs
+
+
+def audit_signatures():
+    """{name: missing-params} for flat-namespace functions whose reference
+    parameter names we don't accept (keyword-call compatibility)."""
+    import inspect
+
+    import heat_tpu as ht
+
+    flat = set()
+    for mod in CORE_MODULES:
+        flat.update(module_all(mod))
+    problems = {}
+    for mod in CORE_MODULES:
+        for name, rargs in module_signatures(mod, flat).items():
+            ours = getattr(ht, name, None)
+            if not callable(ours):
+                continue
+            try:
+                oargs = set(inspect.signature(ours).parameters)
+            except (ValueError, TypeError):
+                continue
+            missing = [a for a in rargs if a not in oargs and a != "self"]
+            if missing:
+                problems.setdefault(name, missing)
+    return problems
+
+
 def audit():
     import heat_tpu as ht
 
@@ -141,6 +183,7 @@ def main() -> int:
     args = parser.parse_args()
 
     present, missing = audit()
+    sig_problems = audit_signatures()
     n_present = sum(len(v) for v in present.values())
     n_missing = sum(len(v) for v in missing.values())
     lines = [
@@ -150,10 +193,16 @@ def main() -> int:
         f"**{n_present + n_missing}** — present here: **{n_present}**, "
         f"missing: **{n_missing}**.",
         "",
+        "Signature layer: every reference parameter name of the flat-namespace "
+        f"functions is accepted here — **{len(sig_problems)}** functions with "
+        "missing parameters.",
+        "",
         "Regenerate: `python scripts/parity_audit.py --write docs/PARITY.md`",
         "(gated by tests/test_parity_audit.py).",
         "",
     ]
+    for name, params in sorted(sig_problems.items()):
+        lines.append(f"- signature gap `{name}`: missing {params}")
     for space in sorted(set(present) | set(missing)):
         label = "ht" if space == "" else f"ht.{space}"
         lines.append(
@@ -166,7 +215,7 @@ def main() -> int:
         with open(args.write, "w", encoding="utf-8") as f:
             f.write(report)
     print(report)
-    return n_missing
+    return n_missing + len(sig_problems)
 
 
 if __name__ == "__main__":

@@ -32,3 +32,6 @@ class TestParityAudit(TestCase):
         self.assertEqual(missing, {}, f"missing reference names: {missing}")
         # the audited surface should not silently shrink either
         self.assertGreaterEqual(n_present, 328)
+        # signature layer: every reference parameter name is accepted
+        sig_problems = parity_audit.audit_signatures()
+        self.assertEqual(sig_problems, {}, f"signature gaps: {sig_problems}")

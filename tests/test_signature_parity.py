@@ -1,0 +1,138 @@
+"""Behavior of the reference kwargs added for signature parity
+(diff prepend/append, cross axis trio, bucketize out_int32, histogram
+normed, eye order, save_csv encoding/truncate) — NumPy is the oracle.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+class TestSignatureKwargs(TestCase):
+    def test_diff_prepend_append(self):
+        data = np.arange(20, dtype=np.float32).reshape(4, 5) ** 2
+        for split in [None, 0, 1]:
+            x = ht.array(data, split=split)
+            self.assert_array_equal(
+                ht.diff(x, axis=1, prepend=0.0), np.diff(data, axis=1, prepend=0.0)
+            )
+            app = np.full((4, 1), 7.0, np.float32)
+            self.assert_array_equal(
+                ht.diff(x, axis=1, append=ht.array(app, split=split)),
+                np.diff(data, axis=1, append=app),
+            )
+            self.assert_array_equal(
+                ht.diff(x, n=2, axis=0, prepend=1.0, append=2.0),
+                np.diff(data, n=2, axis=0, prepend=1.0, append=2.0),
+            )
+
+    def test_cross_axis_trio(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((3, 6)).astype(np.float32)
+        b = rng.standard_normal((3, 6)).astype(np.float32)
+        got = ht.cross(ht.array(a), ht.array(b), axisa=0, axisb=0, axisc=0)
+        np.testing.assert_allclose(
+            got.numpy(), np.cross(a, b, axisa=0, axisb=0, axisc=0), rtol=1e-5
+        )
+        # axis overrides the trio
+        got = ht.cross(ht.array(a.T), ht.array(b.T), axis=1)
+        np.testing.assert_allclose(got.numpy(), np.cross(a.T, b.T, axis=1), rtol=1e-5)
+
+    def test_cross_split_follows_permuted_axes(self):
+        """a (3, N) split=1 with axisa=0: the sharded N dim lands at output
+        index 0 and the split metadata must follow it."""
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((3, 16)).astype(np.float32)
+        b = rng.standard_normal((3, 16)).astype(np.float32)
+        got = ht.cross(
+            ht.array(a, split=1), ht.array(b, split=1), axisa=0, axisb=0, axisc=1
+        )
+        expected = np.cross(a, b, axisa=0, axisb=0, axisc=1)  # (16, 3)
+        self.assertEqual(got.split, 0)
+        self.assert_array_equal(got, expected)
+        # 2-vector inputs: the vector axis disappears, split follows
+        a2 = rng.standard_normal((2, 16)).astype(np.float32)
+        got2 = ht.cross(
+            ht.array(a2, split=1), ht.array(a2[::-1].copy(), split=1),
+            axisa=0, axisb=0,
+        )
+        expected2 = np.cross(a2, a2[::-1], axisa=0, axisb=0)  # (16,)
+        self.assertEqual(got2.split, 0)
+        self.assert_array_equal(got2, expected2)
+
+    def test_diff_prepend_upcasts_like_numpy(self):
+        data = np.arange(6, dtype=np.int32)
+        got = ht.diff(ht.array(data), prepend=0.5)
+        expected = np.diff(data, prepend=0.5)
+        np.testing.assert_allclose(got.numpy().astype(np.float64), expected)
+
+    def test_logaddexp2_runs(self):
+        a = np.array([1.0, 2.0], np.float32)
+        self.assert_array_equal(
+            ht.logaddexp2(x1=ht.array(a), x2=ht.array(a)), np.logaddexp2(a, a),
+        )
+
+    def test_bucketize_out_int32(self):
+        x = ht.array(np.array([0.5, 1.5, 2.5], np.float32))
+        b = np.array([1.0, 2.0], np.float32)
+        out = ht.bucketize(x, b, out_int32=True)
+        self.assertEqual(np.asarray(out.larray).dtype, np.int32)
+        np.testing.assert_array_equal(out.numpy(), [0, 1, 2])
+
+    def test_histogram_normed_alias(self):
+        data = np.random.default_rng(1).random(100).astype(np.float32)
+        h1, e1 = ht.histogram(ht.array(data), bins=8, normed=True)
+        h2, e2 = ht.histogram(ht.array(data), bins=8, density=True)
+        np.testing.assert_allclose(h1.numpy(), h2.numpy())
+
+    def test_eye_order(self):
+        self.assert_array_equal(ht.eye(4, order="C"), np.eye(4, dtype=np.float32))
+        with self.assertRaises(NotImplementedError):
+            ht.eye(4, order="F")
+
+    def test_save_csv_encoding_truncate(self):
+        data = ht.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "out.csv")
+            ht.save_csv(data, path, decimals=1, encoding="utf-8")
+            ht.save_csv(data, path, decimals=1, truncate=False)  # append
+            with open(path, encoding="utf-8") as fh:
+                lines = [l for l in fh.read().splitlines() if l]
+            self.assertEqual(len(lines), 4)  # 2 rows written twice
+            ht.save_csv(data, path, decimals=1)  # truncate=True default
+            with open(path, encoding="utf-8") as fh:
+                lines = [l for l in fh.read().splitlines() if l]
+            self.assertEqual(len(lines), 2)
+
+    def test_save_csv_append_does_not_repeat_header(self):
+        data = ht.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "out.csv")
+            ht.save_csv(data, path, header_lines=["c1,c2,c3"], decimals=1)
+            ht.save_csv(data, path, header_lines=["c1,c2,c3"], decimals=1,
+                        truncate=False)
+            with open(path, encoding="utf-8") as fh:
+                lines = [l for l in fh.read().splitlines() if l]
+            self.assertEqual(lines.count("c1,c2,c3"), 1)  # header once, at top
+            self.assertEqual(lines[0], "c1,c2,c3")
+            self.assertEqual(len(lines), 5)  # header + 2 rows + 2 rows
+
+    def test_keyword_calls_with_reference_names(self):
+        """The rename layer: reference keyword spellings work."""
+        a = ht.array(np.array([1.0, 2.0], np.float32))
+        b = ht.array(np.array([2.0, 2.0], np.float32))
+        self.assertTrue(bool(ht.eq(x=a, y=b).numpy()[1]))
+        self.assertFalse(ht.equal(x=a, y=b))
+        self.assert_array_equal(ht.logical_not(x=ht.array(np.array([True, False]))),
+                                np.array([False, True]))
+        self.assert_array_equal(ht.neg(a=a), np.array([-1.0, -2.0], np.float32))
+        self.assert_array_equal(ht.flip(a=a), np.array([2.0, 1.0], np.float32))
+        self.assert_array_equal(
+            ht.arctan2(x1=a, x2=b), np.arctan2([1.0, 2.0], [2.0, 2.0]).astype(np.float32)
+        )
+        s, i = ht.sort(a=ht.array(np.array([3.0, 1.0, 2.0], np.float32)))
+        self.assert_array_equal(s, np.array([1.0, 2.0, 3.0], np.float32))
