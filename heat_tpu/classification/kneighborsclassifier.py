@@ -32,6 +32,18 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
         self.y = None
         self.classes_ = None
 
+    @staticmethod
+    def one_hot_encoding(x: DNDarray) -> DNDarray:
+        """One-hot-encode a vector / single-column matrix of class indices
+        (reference: kneighborsclassifier.py:45)."""
+        from ..core import factories, statistics
+
+        labels = x.larray.reshape(-1).astype("int32")
+        n_features = int(statistics.max(x).item()) + 1
+        encoded = jax.nn.one_hot(labels, n_features, dtype="float32")
+        out = factories.array(encoded, split=x.split, device=x.device, comm=x.comm)
+        return out
+
     def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
         """Store the training set (reference: kneighborsclassifier.py:62).
         Labels may be class indices (1-D) or one-hot (2-D)."""

@@ -77,9 +77,9 @@ class Laplacian:
         degree = jnp.sum(A, axis=1)
         return jnp.diag(degree) - A
 
-    def construct(self, x: DNDarray) -> DNDarray:
+    def construct(self, X: DNDarray) -> DNDarray:
         """Build the Laplacian of the dataset (reference: laplacian.py:118)."""
-        S = self.similarity_metric(x)
+        S = self.similarity_metric(X)
         A = S.larray
         if self.mode == "eNeighbour":
             key, value = self.epsilon
@@ -92,6 +92,6 @@ class Laplacian:
         A = A - jnp.diag(jnp.diagonal(A))
         L = self._normalized_symmetric_L(A) if self.definition == "norm_sym" else self._simple_L(A)
         out = DNDarray(
-            L, tuple(L.shape), types.canonical_heat_type(L.dtype), S.split, x.device, x.comm
+            L, tuple(L.shape), types.canonical_heat_type(L.dtype), S.split, X.device, X.comm
         )
         return _ensure_split(out, S.split)

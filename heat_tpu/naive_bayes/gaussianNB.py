@@ -141,6 +141,34 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         )
         return log_prior[None, :] + n_ij + quad
 
+    def logsumexp(self, a: DNDarray, axis=None, b=None, keepdims: bool = False,
+                  return_sign: bool = False) -> DNDarray:
+        """Numerically stable ``log(sum(b * exp(a)))`` (reference:
+        gaussianNB.py:407, adapted there from scikit-learn)."""
+        av = a.larray if isinstance(a, DNDarray) else jnp.asarray(a)
+        bv = b.larray if isinstance(b, DNDarray) else b
+        m = jnp.max(av, axis=axis, keepdims=True)
+        m = jnp.where(jnp.isfinite(m), m, 0.0)
+        e = jnp.exp(av - m)
+        if bv is not None:
+            e = e * bv
+        s = jnp.sum(e, axis=axis, keepdims=keepdims)
+        sign = jnp.sign(s)
+        if not keepdims:
+            m = jnp.squeeze(m, axis=axis) if axis is not None else jnp.squeeze(m)
+        out_v = jnp.log(jnp.abs(s) if return_sign else s) + m
+        from ..core import factories
+
+        if isinstance(a, DNDarray):
+            split = a.split if out_v.ndim == a.larray.ndim else None
+            out = factories.array(out_v, split=split, device=a.device, comm=a.comm)
+            if return_sign:
+                return out, factories.array(sign, split=split, device=a.device, comm=a.comm)
+            return out
+        if return_sign:
+            return factories.array(out_v), factories.array(sign)
+        return factories.array(out_v)
+
     def predict_log_proba(self, x: DNDarray) -> DNDarray:
         """Per-class log probabilities (reference: gaussianNB.py:480)."""
         jll = self._joint_log_likelihood(x)

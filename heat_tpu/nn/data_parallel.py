@@ -72,8 +72,13 @@ class DataParallel:
         optimizer: Optional[Any] = None,
         loss_fn: Optional[Callable] = None,
         blocking: bool = True,
+        blocking_parameter_updates: Optional[bool] = None,
     ):
+        if blocking_parameter_updates is not None:
+            # the reference's keyword spelling (data_parallel.py:52)
+            blocking = blocking_parameter_updates
         self.module = module
+        self.blocking = blocking
         self.comm = sanitize_comm(comm)
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -170,6 +175,11 @@ class DataParallel:
             )
             return _ensure_split(wrapped, 0)
         return out
+
+    def forward(self, x):
+        """Reference keyword for the forward pass (data_parallel.py's
+        torch-module spelling); identical to calling the wrapper."""
+        return self(x)
 
     # ------------------------------------------------------------ train step
     def train_step(self, batch, targets):

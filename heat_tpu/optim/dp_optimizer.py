@@ -34,10 +34,15 @@ class DataParallelOptimizer:
     """Thin wrapper over an optax gradient transformation (reference:
     dp_optimizer.py:834 wraps a torch optimizer)."""
 
-    def __init__(self, optimizer: optax.GradientTransformation, blocking: bool = False):
-        if not hasattr(optimizer, "update"):
+    def __init__(self, torch_optimizer: optax.GradientTransformation = None,
+                 blocking: bool = False, optimizer=None):
+        # the reference names the wrapped optimizer ``torch_optimizer``
+        # (dp_optimizer.py:834); ``optimizer`` stays as an alias
+        if torch_optimizer is None:
+            torch_optimizer = optimizer
+        if not hasattr(torch_optimizer, "update"):
             raise TypeError("optimizer must be an optax GradientTransformation")
-        self.tx = optimizer
+        self.tx = torch_optimizer
         self.blocking = blocking
         self.state = None
         self._model = None
@@ -105,9 +110,18 @@ class DASO:
         max_global_skips: int = 8,
         sending_chunk_size: int = 10_000_000,
         downcast_type=jnp.bfloat16,
+        use_mpi_groups: bool = True,
+        skip_reduction_factor: int = 2,
+        local_skip_factor: int = 4,
         verbose: bool = False,
     ):
         self.local_optimizer = local_optimizer
+        # reference knobs kept by name: use_mpi_groups is the reference's
+        # sub-communicator choice (meaningless under XLA collectives but
+        # accepted); the factors shape the skip adaptation below
+        self.use_mpi_groups = use_mpi_groups
+        self.skip_reduction_factor = max(int(skip_reduction_factor), 1)
+        self.local_skip_factor = max(int(local_skip_factor), 1)
         self.comm = sanitize_comm(comm)
         self.mesh = mesh if mesh is not None else self.comm.mesh
         self.axis_names = tuple(self.mesh.axis_names)
@@ -179,10 +193,12 @@ class DASO:
             return "cooldown"
         return "cycling"
 
-    def epoch_loss_logic(self, loss: float) -> None:
+    def epoch_loss_logic(self, loss: float, loss_globally_averaged: bool = False) -> None:
         """Adapt global_skips from the epoch loss trend (reference:
         dp_optimizer.py:336): stable loss → skip more; worsening → skip
-        less."""
+        less.  ``loss_globally_averaged`` mirrors the reference flag: when
+        False the loss is averaged across slices first (here a host-side
+        mean of a replicated scalar — already averaged by the sync)."""
         self._last_losses.append(float(loss))
         if len(self._last_losses) < 2:
             self.global_skip = 1 if self.phase == "cycling" else 0
@@ -194,11 +210,35 @@ class DASO:
         rel_impr = (prev - curr) / max(abs(prev), 1e-12)
         if rel_impr < 0:
             # loss worsening → sync more often (reference: dp_optimizer.py:376)
-            self.global_skip = max(self.global_skip // 2, 1)
+            self.global_skip = max(self.global_skip // self.skip_reduction_factor, 1)
         elif rel_impr < self.stability_level:
             # plateau → safe to skip more syncs
             self.global_skip = min(max(self.global_skip * 2, 1), self.max_global_skips)
         # strong improvement → keep the current cadence
+
+    @property
+    def local_skip(self) -> int:
+        """Intra-slice skip cadence derived from the global one (reference:
+        local_skip ≈ global_skips / local_skip_factor). On TPU the ICI
+        reduction is fused into the step, so this is informational."""
+        return max(self.global_skip // self.local_skip_factor, 1)
+
+    def add_scaler(self, scaler) -> None:
+        """Accept a mixed-precision grad scaler (reference:
+        dp_optimizer.py — torch.cuda.amp.GradScaler). XLA's bf16 path needs
+        no loss scaling; the scaler is stored for API parity."""
+        self.scaler = scaler
+
+    def set_model(self, model) -> None:
+        """Bind the model after construction (reference spelling)."""
+        self._bind_model(model)
+
+    def reset(self) -> None:
+        """Reset the skip/phase state machine (reference: dp_optimizer.py)."""
+        self.global_skip = 0
+        self.epoch = 0
+        self.batches_seen = 0
+        self._last_losses = []
 
     def next_epoch(self, epoch_loss: float) -> None:
         """Advance the phase machine at epoch end."""

@@ -33,26 +33,50 @@ class Dataset:
     The reference's notion of "local shard as torch dataset" does not apply
     under the single-controller model; indexing is global."""
 
-    def __init__(self, array: DNDarray, *arrays: DNDarray, transform=None):
+    def __init__(self, array: DNDarray, *arrays: DNDarray, transform=None,
+                 transforms=None, ishuffle: bool = False, test_set: bool = False):
         self.arrays = (array,) + arrays
         n = array.shape[0]
         for a in self.arrays[1:]:
             if a.shape[0] != n:
                 raise ValueError("all arrays must share the sample dimension")
+        # reference spellings (datatools.py:143): ``transforms`` is one
+        # callable per array, applied to that array's item; ``ishuffle``
+        # selects the non-blocking epoch shuffle (same call under async
+        # dispatch); ``test_set`` disables shuffling.  ``transform`` (ours)
+        # receives the whole item tuple instead.
+        if transforms is not None and not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.transforms = list(transforms) if transforms is not None else None
         self.transform = transform
+        self.ishuffle = ishuffle
+        self.test_set = test_set
 
     def __len__(self) -> int:
         return self.arrays[0].shape[0]
 
     def __getitem__(self, index):
         items = tuple(a.larray[index] for a in self.arrays)
+        if self.transforms is not None:
+            # per-array transforms, reference contract (datatools.py:176)
+            items = tuple(
+                t(item) if t is not None else item
+                for t, item in zip(
+                    list(self.transforms) + [None] * (len(items) - len(self.transforms)),
+                    items,
+                )
+            )
+            return items[0] if len(items) == 1 else items
         if self.transform is not None:
-            items = self.transform(*items)
+            return self.transform(*items)
         return items[0] if len(items) == 1 else items
 
     def shuffle(self) -> None:
         """Globally shuffle all arrays with one shared permutation
-        (reference: dataset_shuffle, datatools.py:246)."""
+        (reference: dataset_shuffle, datatools.py:246).  A no-op for test
+        sets, like the reference's guard (datatools.py:231)."""
+        if getattr(self, "test_set", False):
+            return
         n = len(self)
         perm = ht_random.randperm(n).larray
         new = []
@@ -63,6 +87,16 @@ class Dataset:
             )
             new.append(_ensure_split(wrapped, a.split))
         self.arrays = tuple(new)
+
+    def Shuffle(self) -> None:
+        """Reference spelling of the blocking epoch shuffle
+        (datatools.py:196)."""
+        self.shuffle()
+
+    def Ishuffle(self) -> None:
+        """Reference spelling of the non-blocking epoch shuffle
+        (datatools.py:204); identical under JAX's async dispatch."""
+        self.shuffle()
 
 
 class DataLoader:
@@ -79,6 +113,11 @@ class DataLoader:
         batch_size: int = 1,
         shuffle: bool = False,
         drop_last: bool = False,
+        num_workers: int = 0,
+        collate_fn=None,
+        pin_memory: bool = False,
+        timeout: float = 0,
+        worker_init_fn=None,
     ):
         if isinstance(dataset, DNDarray):
             dataset = Dataset(dataset)
@@ -86,6 +125,14 @@ class DataLoader:
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.drop_last = drop_last
+        # torch-DataLoader knobs the reference forwards (datatools.py:16).
+        # Worker processes/pinning don't exist in this IO model (batches are
+        # device-resident slices); collate_fn is honored.
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn
+        self.pin_memory = pin_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -94,14 +141,15 @@ class DataLoader:
         return -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator:
-        if self.shuffle:
+        if self.shuffle and not getattr(self.dataset, "test_set", False):
             self.dataset.shuffle()
         n = len(self.dataset)
         nbatches = len(self)
         for i in range(nbatches):
             lo = i * self.batch_size
             hi = min(lo + self.batch_size, n)
-            yield self.dataset[lo:hi]
+            batch = self.dataset[lo:hi]
+            yield self.collate_fn(batch) if self.collate_fn is not None else batch
 
 
 def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:

@@ -84,11 +84,30 @@ class PartialH5Dataset:
     def __iter__(self) -> "PartialH5DataLoaderIter":
         return PartialH5DataLoaderIter(self)
 
+    def Shuffle(self) -> None:
+        """Reference spelling (partial_dataset.py): slab order is disk
+        order here — the streaming model reads sequential slabs, shuffling
+        happens downstream per batch."""
+
+    def Ishuffle(self) -> None:
+        """Reference spelling; see :meth:`Shuffle`."""
+
+    def thread_replace_converted_batches(self) -> None:
+        """Reference hook (partial_dataset.py): its convert-thread handoff
+        is replaced by the prefetch queue in
+        :class:`PartialH5DataLoaderIter` (and the C++ PrefetchPipeline);
+        nothing to do per call."""
+
 
 class PartialH5DataLoaderIter:
-    """Background-threaded slab iterator (reference: partial_dataset.py:224)."""
+    """Background-threaded slab iterator (reference: partial_dataset.py:224).
 
-    def __init__(self, dataset: PartialH5Dataset):
+    ``loader`` is the reference's parameter name — it passes its DataLoader
+    whose ``.dataset`` is the :class:`PartialH5Dataset`; a bare dataset is
+    accepted too."""
+
+    def __init__(self, loader):
+        dataset = getattr(loader, "dataset", loader)
         self.dataset = dataset
         self._queue: "queue.Queue" = queue.Queue(maxsize=dataset.prefetch_depth)
         self._error: Optional[BaseException] = None

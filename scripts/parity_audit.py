@@ -161,6 +161,57 @@ def audit_signatures():
     return problems
 
 
+def audit_class_signatures():
+    """{qualified-method: missing-params} for public classes of the
+    estimator/nn/optim/data subpackages: every public reference method must
+    exist here and accept the reference's parameter names."""
+    import inspect
+
+    import heat_tpu as ht
+
+    problems = {}
+    for pkg, files in SUBPACKAGES.items():
+        target = ht
+        for part in filter(None, pkg.split(".")):
+            target = getattr(target, part, None)
+        if target is None:
+            continue
+        for f in files:
+            full = os.path.join(REFERENCE, f)
+            if not os.path.exists(full):
+                continue
+            tree = ast.parse(open(full, encoding="utf-8").read())
+            for node in tree.body:
+                if not (isinstance(node, ast.ClassDef) and not node.name.startswith("_")):
+                    continue
+                ours = getattr(target, node.name, None)
+                if ours is None:
+                    problems[f"{pkg}.{node.name}"] = ["<class missing>"]
+                    continue
+                for meth in node.body:
+                    if not isinstance(meth, ast.FunctionDef):
+                        continue
+                    if meth.name.startswith("_") and meth.name != "__init__":
+                        continue
+                    key = f"{pkg}.{node.name}.{meth.name}"
+                    om = getattr(ours, meth.name, None)
+                    if om is None:
+                        problems[key] = ["<method missing>"]
+                        continue
+                    if not callable(om):
+                        continue  # property stand-in is fine
+                    try:
+                        oargs = set(inspect.signature(om).parameters)
+                    except (ValueError, TypeError):
+                        continue
+                    rargs = [a.arg for a in meth.args.args + meth.args.kwonlyargs
+                             if a.arg != "self"]
+                    missing = [a for a in rargs if a not in oargs]
+                    if missing:
+                        problems[key] = missing
+    return problems
+
+
 def audit():
     import heat_tpu as ht
 
@@ -184,6 +235,7 @@ def main() -> int:
 
     present, missing = audit()
     sig_problems = audit_signatures()
+    cls_problems = audit_class_signatures()
     n_present = sum(len(v) for v in present.values())
     n_missing = sum(len(v) for v in missing.values())
     lines = [
@@ -197,12 +249,18 @@ def main() -> int:
         f"functions is accepted here — **{len(sig_problems)}** functions with "
         "missing parameters.",
         "",
+        "Class layer: every public method of the estimator/nn/optim/data "
+        "classes exists with the reference's parameter names — "
+        f"**{len(cls_problems)}** gaps.",
+        "",
         "Regenerate: `python scripts/parity_audit.py --write docs/PARITY.md`",
         "(gated by tests/test_parity_audit.py).",
         "",
     ]
     for name, params in sorted(sig_problems.items()):
         lines.append(f"- signature gap `{name}`: missing {params}")
+    for name, params in sorted(cls_problems.items()):
+        lines.append(f"- class gap `{name}`: {params}")
     for space in sorted(set(present) | set(missing)):
         label = "ht" if space == "" else f"ht.{space}"
         lines.append(
@@ -215,7 +273,8 @@ def main() -> int:
         with open(args.write, "w", encoding="utf-8") as f:
             f.write(report)
     print(report)
-    return n_missing + len(sig_problems)
+    # exit status: nonzero iff any gap, capped so it cannot wrap mod 256
+    return min(n_missing + len(sig_problems) + len(cls_problems), 100)
 
 
 if __name__ == "__main__":

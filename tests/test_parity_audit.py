@@ -35,3 +35,6 @@ class TestParityAudit(TestCase):
         # signature layer: every reference parameter name is accepted
         sig_problems = parity_audit.audit_signatures()
         self.assertEqual(sig_problems, {}, f"signature gaps: {sig_problems}")
+        # class layer: estimator/nn/optim/data methods + parameter names
+        cls_problems = parity_audit.audit_class_signatures()
+        self.assertEqual(cls_problems, {}, f"class gaps: {cls_problems}")
