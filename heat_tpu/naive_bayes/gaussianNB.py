@@ -142,7 +142,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         return log_prior[None, :] + n_ij + quad
 
     def logsumexp(self, a: DNDarray, axis=None, b=None, keepdims: bool = False,
-                  return_sign: bool = False) -> DNDarray:
+                  return_sign: bool = False):
         """Numerically stable ``log(sum(b * exp(a)))`` (reference:
         gaussianNB.py:407, adapted there from scikit-learn)."""
         av = a.larray if isinstance(a, DNDarray) else jnp.asarray(a)

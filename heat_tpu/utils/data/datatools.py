@@ -44,10 +44,16 @@ class Dataset:
         # callable per array, applied to that array's item; ``ishuffle``
         # selects the non-blocking epoch shuffle (same call under async
         # dispatch); ``test_set`` disables shuffling.  ``transform`` (ours)
-        # receives the whole item tuple instead.
+        # receives the whole item tuple instead — mutually exclusive.
+        if transform is not None and transforms is not None:
+            raise ValueError("pass either transform (tuple-level) or transforms "
+                             "(per-array), not both")
         if transforms is not None and not isinstance(transforms, (list, tuple)):
             transforms = [transforms]
-        self.transforms = list(transforms) if transforms is not None else None
+        if transforms is not None:
+            # pad once to one entry per array; __getitem__ just zips
+            transforms = list(transforms) + [None] * (len(self.arrays) - len(transforms))
+        self.transforms = transforms
         self.transform = transform
         self.ishuffle = ishuffle
         self.test_set = test_set
@@ -61,10 +67,7 @@ class Dataset:
             # per-array transforms, reference contract (datatools.py:176)
             items = tuple(
                 t(item) if t is not None else item
-                for t, item in zip(
-                    list(self.transforms) + [None] * (len(items) - len(self.transforms)),
-                    items,
-                )
+                for t, item in zip(self.transforms, items)
             )
             return items[0] if len(items) == 1 else items
         if self.transform is not None:
@@ -75,7 +78,7 @@ class Dataset:
         """Globally shuffle all arrays with one shared permutation
         (reference: dataset_shuffle, datatools.py:246).  A no-op for test
         sets, like the reference's guard (datatools.py:231)."""
-        if getattr(self, "test_set", False):
+        if self.test_set:
             return
         n = len(self)
         perm = ht_random.randperm(n).larray
@@ -141,8 +144,8 @@ class DataLoader:
         return -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator:
-        if self.shuffle and not getattr(self.dataset, "test_set", False):
-            self.dataset.shuffle()
+        if self.shuffle:
+            self.dataset.shuffle()  # no-op for test_set datasets
         n = len(self.dataset)
         nbatches = len(self)
         for i in range(nbatches):

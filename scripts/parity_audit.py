@@ -10,8 +10,10 @@ mpi4py), so its ``__all__`` lists are read statically with ``ast``.
 Usage:
     python scripts/parity_audit.py [--write docs/PARITY.md]
 
-Exit status is the number of missing names — 0 means full surface parity.
-tests/test_parity_audit.py runs this as a regression gate.
+Exit status is the total gap count across all four layers (missing names,
+function signatures, class methods, DNDarray methods), capped at 100 —
+0 means full surface parity. tests/test_parity_audit.py runs this as a
+regression gate.
 """
 
 from __future__ import annotations
@@ -161,12 +163,31 @@ def audit_signatures():
     return problems
 
 
+def _method_gap(meth, ours):
+    """Compare one reference ``ast.FunctionDef`` against our attribute.
+
+    Returns a non-empty list of missing parameter names, ``["<method
+    missing>"]`` when the attribute does not exist, or ``None`` when the
+    method is covered (shared by the class and DNDarray audit layers)."""
+    import inspect
+
+    if ours is None:
+        return ["<method missing>"]
+    if isinstance(ours, property) or not callable(ours):
+        return None  # property stand-in is fine
+    try:
+        oargs = set(inspect.signature(ours).parameters)
+    except (ValueError, TypeError):
+        return None
+    rargs = [a.arg for a in meth.args.args + meth.args.kwonlyargs if a.arg != "self"]
+    missing = [a for a in rargs if a not in oargs]
+    return missing or None
+
+
 def audit_class_signatures():
     """{qualified-method: missing-params} for public classes of the
     estimator/nn/optim/data subpackages: every public reference method must
     exist here and accept the reference's parameter names."""
-    import inspect
-
     import heat_tpu as ht
 
     problems = {}
@@ -193,22 +214,43 @@ def audit_class_signatures():
                         continue
                     if meth.name.startswith("_") and meth.name != "__init__":
                         continue
-                    key = f"{pkg}.{node.name}.{meth.name}"
-                    om = getattr(ours, meth.name, None)
-                    if om is None:
-                        problems[key] = ["<method missing>"]
-                        continue
-                    if not callable(om):
-                        continue  # property stand-in is fine
-                    try:
-                        oargs = set(inspect.signature(om).parameters)
-                    except (ValueError, TypeError):
-                        continue
-                    rargs = [a.arg for a in meth.args.args + meth.args.kwonlyargs
-                             if a.arg != "self"]
-                    missing = [a for a in rargs if a not in oargs]
-                    if missing:
-                        problems[key] = missing
+                    gap = _method_gap(meth, getattr(ours, meth.name, None))
+                    if gap:
+                        problems[f"{pkg}.{node.name}.{meth.name}"] = gap
+    return problems
+
+
+# reference DNDarray members that are deliberately not mirrored:
+# name-mangled internals are implementation detail, and __torch_proxy__ is
+# the reference's torch-specific 0-stride indexing trick (dndarray.py:1852)
+# with no meaning for jax.Arrays
+_DNDARRAY_EXCLUDED = {"__torch_proxy__"}
+
+
+def audit_dndarray():
+    """{method: missing-params} for the reference DNDarray's public method
+    surface (everything except mangled privates and the torch proxy)."""
+    import heat_tpu as ht
+
+    full = os.path.join(REFERENCE, "heat/core/dndarray.py")
+    tree = ast.parse(open(full, encoding="utf-8").read())
+    cls = next(
+        n for n in tree.body if isinstance(n, ast.ClassDef) and n.name == "DNDarray"
+    )
+    problems = {}
+    for meth in cls.body:
+        if not isinstance(meth, ast.FunctionDef):
+            continue
+        name = meth.name
+        if name in _DNDARRAY_EXCLUDED:
+            continue
+        if name.startswith("__") and not name.endswith("__"):
+            continue  # name-mangled internals
+        if name.startswith("_") and not name.startswith("__"):
+            continue
+        gap = _method_gap(meth, getattr(ht.DNDarray, name, None))
+        if gap:
+            problems[name] = gap
     return problems
 
 
@@ -236,6 +278,7 @@ def main() -> int:
     present, missing = audit()
     sig_problems = audit_signatures()
     cls_problems = audit_class_signatures()
+    nd_problems = audit_dndarray()
     n_present = sum(len(v) for v in present.values())
     n_missing = sum(len(v) for v in missing.values())
     lines = [
@@ -253,6 +296,10 @@ def main() -> int:
         "classes exists with the reference's parameter names — "
         f"**{len(cls_problems)}** gaps.",
         "",
+        "DNDarray layer: the reference array class's public method surface "
+        f"(mangled internals and `__torch_proxy__` excluded) — "
+        f"**{len(nd_problems)}** gaps.",
+        "",
         "Regenerate: `python scripts/parity_audit.py --write docs/PARITY.md`",
         "(gated by tests/test_parity_audit.py).",
         "",
@@ -261,6 +308,8 @@ def main() -> int:
         lines.append(f"- signature gap `{name}`: missing {params}")
     for name, params in sorted(cls_problems.items()):
         lines.append(f"- class gap `{name}`: {params}")
+    for name, params in sorted(nd_problems.items()):
+        lines.append(f"- DNDarray gap `{name}`: {params}")
     for space in sorted(set(present) | set(missing)):
         label = "ht" if space == "" else f"ht.{space}"
         lines.append(
@@ -274,7 +323,9 @@ def main() -> int:
             f.write(report)
     print(report)
     # exit status: nonzero iff any gap, capped so it cannot wrap mod 256
-    return min(n_missing + len(sig_problems) + len(cls_problems), 100)
+    return min(
+        n_missing + len(sig_problems) + len(cls_problems) + len(nd_problems), 100
+    )
 
 
 if __name__ == "__main__":

@@ -38,3 +38,6 @@ class TestParityAudit(TestCase):
         # class layer: estimator/nn/optim/data methods + parameter names
         cls_problems = parity_audit.audit_class_signatures()
         self.assertEqual(cls_problems, {}, f"class gaps: {cls_problems}")
+        # DNDarray layer: the array class's public method surface
+        nd_problems = parity_audit.audit_dndarray()
+        self.assertEqual(nd_problems, {}, f"DNDarray gaps: {nd_problems}")
