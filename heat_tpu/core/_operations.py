@@ -164,7 +164,27 @@ def _reduce_op(
     if dtype is not None:
         arr = arr.astype(types.canonical_heat_type(dtype).jax_type())
     call_axis = None if was_none else (axes if len(axes) > 1 else axes[0])
-    result = operation(arr, axis=call_axis, keepdims=keepdims, **kwargs)
+    # 16-bit float inputs accumulate in f32 and cast back (NumPy's fp16
+    # contract): a bf16 accumulator saturates after ~256 terms — the mean
+    # of 1e9 standard normals came out at 1e-2 instead of ~3e-5.  The f32
+    # accumulator rides the op's own dtype kwarg so convert+reduce stay ONE
+    # XLA program even eagerly; an explicit astype would dispatch separately
+    # and materialize an array-sized f32 copy (25.6 GB at bf16[1e8, 64]).
+    # Ops without a dtype kwarg (min/max/argmax/all) are exact in any float
+    # dtype and take the plain path.
+    half = jnp.issubdtype(arr.dtype, jnp.floating) and jnp.dtype(arr.dtype).itemsize < 4
+    result = None
+    if half and dtype is None:
+        try:
+            result = operation(
+                arr, axis=call_axis, keepdims=keepdims, dtype=jnp.float32, **kwargs
+            )
+        except TypeError:
+            result = None
+        if result is not None and jnp.issubdtype(result.dtype, jnp.floating):
+            result = result.astype(arr.dtype)
+    if result is None:
+        result = operation(arr, axis=call_axis, keepdims=keepdims, **kwargs)
 
     # result split (reference: reduced-away split → replicated)
     split = x.split

@@ -84,12 +84,75 @@ def set_state(state: Tuple) -> None:
     __counter = int(state[2])
 
 
-def _sharded_sample(shape, split, device, comm, sampler, jdtype) -> DNDarray:
+_CHUNK_F32_BYTES = 2 << 30  # chunk when the f32 intermediate would top 2 GB
+
+
+def _chunk_sampler(sampler, shape, jdtype):
+    """Wrap ``sampler`` to generate big sub-f32 arrays in row blocks.
+
+    jax.random's samplers compute through a float32 intermediate before the
+    requested-dtype cast, so a bf16[1e8, 64] request transiently wants 2x its
+    own size in HBM and OOMs a 16 GB chip even though the result fits.  Row
+    blocks via fori_loop keep the f32 intermediate per-block (the block key
+    is fold_in(key, block) — deterministic per shape, mesh-size invariant).
+    """
+    import math
+
+    if not shape or jnp.dtype(jdtype).itemsize >= 4:
+        return None
+    f32_bytes = math.prod(shape) * 4
+    if f32_bytes <= _CHUNK_F32_BYTES or shape[0] < 2:
+        return None
+    n_chunks = min(shape[0], -(-f32_bytes // _CHUNK_F32_BYTES))
+    rows = -(-shape[0] // n_chunks)
+    n_full, rem = divmod(shape[0], rows)
+
+    def chunked(key, _shape, _dtype):
+        tail = tuple(shape[1:])
+        zeros = (0,) * len(tail)
+
+        def body(i, out):
+            kb = jax.random.fold_in(key, i)
+            blk = sampler(kb, (rows,) + tail, _dtype)
+            return jax.lax.dynamic_update_slice(out, blk, (i * rows,) + zeros)
+
+        # the output buffer is allocated at the EXACT final shape and updated
+        # in place; a padded buffer + trailing slice would transiently double
+        # the footprint and re-OOM the very case this path exists for
+        out = jnp.zeros(shape, _dtype)
+        out = jax.lax.fori_loop(0, n_full, body, out)
+        if rem:
+            kb = jax.random.fold_in(key, n_full)
+            blk = sampler(kb, (rem,) + tail, _dtype)
+            out = jax.lax.dynamic_update_slice(out, blk, (n_full * rows,) + zeros)
+        return out
+
+    return chunked
+
+
+def _sharded_sample(shape, split, device, comm, sampler, jdtype, upcast=False) -> DNDarray:
     """Generate a sharded sample: jit with out_shardings makes each device
-    generate only its shard while the logical result is mesh-size-invariant."""
+    generate only its shard while the logical result is mesh-size-invariant.
+
+    ``upcast=True`` samples in f32 and rounds to the requested dtype —
+    required for the normal transform, whose direct 16-bit evaluation is
+    biased (bf16 randn measured mean -0.012 over 2.5e9 draws).  Uniforms
+    stay native: their bit-mantissa construction is unbiased in any float
+    dtype, and rounding f32 uniforms would let values hit exactly 1.0.
+    """
     shape = sanitize_shape(shape)
     comm = sanitize_comm(comm)
     key = __next_key()
+    if upcast and jnp.issubdtype(jdtype, jnp.floating) and jnp.dtype(jdtype).itemsize < 4:
+        base_sampler = sampler
+
+        def sampler(k, s, d, _base=base_sampler):  # noqa: ANN001
+            # per block under _chunk_sampler: no array-sized f32 intermediate
+            return _base(k, s, jnp.float32).astype(d)
+
+    chunked = _chunk_sampler(sampler, shape, jdtype)
+    if chunked is not None:
+        sampler = chunked
     split_ = split if len(shape) else None
     # mesh-size invariance: always sample at the LOGICAL shape (the physical
     # pad, if any, is zeros appended afterwards), so the same seed gives the
@@ -131,7 +194,7 @@ def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarr
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
     jdtype = types.canonical_heat_type(dtype).jax_type()
-    return _sharded_sample(shape, split, device, comm, jax.random.normal, jdtype)
+    return _sharded_sample(shape, split, device, comm, jax.random.normal, jdtype, upcast=True)
 
 
 standard_normal = randn

@@ -226,9 +226,9 @@ def mean(x, axis=None) -> DNDarray:
     """Arithmetic mean (reference: statistics.py:892 — merged-moments
     Allreduce there, one partitioned jnp.mean here)."""
     return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False: jnp.mean(
+        lambda t, axis=None, keepdims=False, dtype=None: jnp.mean(
             t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
-            axis=axis, keepdims=keepdims,
+            axis=axis, keepdims=keepdims, dtype=dtype,
         ),
         x, axis=axis,
     )
@@ -269,9 +269,9 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
 def std(x, axis=None, ddof: int = 0) -> DNDarray:
     """Standard deviation (reference: statistics.py:1724)."""
     return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False: jnp.std(
+        lambda t, axis=None, keepdims=False, dtype=None: jnp.std(
             t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
-            axis=axis, ddof=ddof, keepdims=keepdims,
+            axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype,
         ),
         x, axis=axis,
     )
@@ -281,9 +281,9 @@ def var(x, axis=None, ddof: int = 0) -> DNDarray:
     """Variance (reference: statistics.py:1857 — Bennett merged moments there,
     one partitioned jnp.var here)."""
     return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False: jnp.var(
+        lambda t, axis=None, keepdims=False, dtype=None: jnp.var(
             t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
-            axis=axis, ddof=ddof, keepdims=keepdims,
+            axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype,
         ),
         x, axis=axis,
     )

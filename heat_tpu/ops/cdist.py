@@ -101,13 +101,22 @@ def cdist(x: jax.Array, y: jax.Array, *, sqrt: bool = True) -> jax.Array:
     if not forced and (x.shape[0] < 8 or y.shape[0] < 128):
         mode = "off"
     if mode == "off":
-        x32 = x.astype(jnp.float32)
-        y32 = y.astype(jnp.float32)
-        d2 = (
-            jnp.sum(x32 * x32, axis=1, keepdims=True)
-            + jnp.sum(y32 * y32, axis=1)[None, :]
-            - 2.0 * x32 @ y32.T
-        )
-        d2 = jnp.maximum(d2, 0.0)
+        # never materialize an f32 copy of a half-precision operand — either
+        # side can be the huge one (at 1e8x64 bf16 the cast alone is 25.6 GB).
+        # The norms' casts fuse into their reductions; the cross term runs
+        # the MXU on a common native dtype with an f32 accumulator.
+        xsq = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=1, keepdims=True)
+        ysq = jnp.sum(jnp.square(y.astype(jnp.float32)), axis=1)[None, :]
+        if x.dtype == y.dtype == jnp.float32:
+            prod = x @ y.T
+        else:
+            # common dtype for dot_general: cast the smaller operand toward
+            # the other's dtype so the array-sized copy is never the big one
+            common = x.dtype if x.size >= y.size else y.dtype
+            prod = jax.lax.dot_general(
+                x.astype(common), y.astype(common), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        d2 = jnp.maximum(xsq + ysq - 2.0 * prod, 0.0)
         return jnp.sqrt(d2) if sqrt else d2
     return _cdist_pallas(x, y, sqrt=sqrt, interpret=(mode == "interpret"))

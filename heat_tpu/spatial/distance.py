@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core import sanitation, types
@@ -48,11 +49,28 @@ def _result_split(x: DNDarray, y: DNDarray) -> Optional[int]:
     return None
 
 
+@jax.jit
 def _sq_euclidean(xa, ya):
-    """Quadratic expansion ||a-b||² = |a|² + |b|² − 2a·b — MXU-resident."""
-    x2 = jnp.sum(xa * xa, axis=1)[:, None]
-    y2 = jnp.sum(ya * ya, axis=1)[None, :]
-    cross = jnp.matmul(xa, ya.T)
+    """Quadratic expansion ||a-b||² = |a|² + |b|² − 2a·b — MXU-resident,
+    one compiled program (eager dispatch would run the casts/squares as
+    separate XLA programs and materialize array-sized temporaries).
+
+    Half-precision inputs accumulate in f32 (fused casts in the norm
+    reductions, ``preferred_element_type`` on the cross term — never an
+    array-sized f32 copy) so labels computed here agree with the
+    f32-accumulated fused KMeans loop; f32/f64 inputs keep their native
+    precision and dtype.  ``_prep`` has already unified the dtypes."""
+    half = jnp.dtype(xa.dtype).itemsize < 4
+    if not half:
+        x2 = jnp.sum(xa * xa, axis=1)[:, None]
+        y2 = jnp.sum(ya * ya, axis=1)[None, :]
+        cross = jnp.matmul(xa, ya.T)
+    else:
+        x2 = jnp.sum(jnp.square(xa.astype(jnp.float32)), axis=1)[:, None]
+        y2 = jnp.sum(jnp.square(ya.astype(jnp.float32)), axis=1)[None, :]
+        cross = jax.lax.dot_general(
+            xa, ya, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
     return jnp.maximum(x2 + y2 - 2.0 * cross, 0.0)
 
 
