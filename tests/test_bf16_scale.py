@@ -75,6 +75,36 @@ class TestBf16KMeans(TestCase):
         want = np.sort(centers, axis=0)
         np.testing.assert_allclose(got, want, atol=0.5)
 
+    def test_other_estimators_accept_bf16(self):
+        """KMedians/KMedoids/Lasso run on bf16 data and stay near their
+        f32 answers (quantization-level error only)."""
+        rng = np.random.default_rng(2)
+        centers = rng.standard_normal((3, 8)).astype(np.float32) * 6
+        data = np.concatenate(
+            [c + rng.standard_normal((200, 8)).astype(np.float32) for c in centers]
+        )
+        x = ht.array(data, dtype=ht.bfloat16, split=0)
+        for cls, tol in ((ht.cluster.KMedians, 0.5), (ht.cluster.KMedoids, 1.5)):
+            est = cls(n_clusters=3, max_iter=30)
+            est.fit(x)
+            got = np.sort(
+                np.asarray(est.cluster_centers_.larray).astype(np.float32), axis=0
+            )
+            err = np.abs(got - np.sort(centers, axis=0)).max()
+            self.assertLess(err, tol, cls.__name__)
+
+        Xf = rng.standard_normal((400, 12)).astype(np.float32)
+        w = np.zeros(12, np.float32)
+        w[:3] = [2.0, -3.0, 1.5]
+        yv = Xf @ w + 0.01 * rng.standard_normal(400).astype(np.float32)
+        las = ht.regression.Lasso(lam=0.01, max_iter=100)
+        las.fit(
+            ht.array(Xf, dtype=ht.bfloat16, split=0),
+            ht.array(yv[:, None], dtype=ht.bfloat16, split=0),
+        )
+        coef = np.asarray(las.coef_.larray).ravel()[:3].astype(np.float32)
+        self.assertLess(np.abs(coef - w[:3]).max(), 0.3)
+
     def test_predict_bf16(self):
         rng = np.random.default_rng(1)
         data = rng.standard_normal((200, 4)).astype(np.float32)
