@@ -64,3 +64,41 @@ class TestHybridMesh(TestCase):
 
         with self.assertRaises(ValueError):
             hybrid_mesh({"dp": 8}, {"dp": 1})
+
+
+class TestGraftEntryBootstrap(TestCase):
+    """The driver imports __graft_entry__ directly and calls
+    dryrun_multichip(8) in a fresh process; round 1 failed because the
+    CPU-fallback bootstrap lived only in the __main__ block."""
+
+    @staticmethod
+    def _import_graft_entry():
+        import os
+        import sys
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, repo_root)
+        try:
+            import __graft_entry__ as ge
+        finally:
+            sys.path.pop(0)
+        return ge
+
+    def test_bootstrap_devices_uses_initialized_backend(self):
+        # Force backend init so the bootstrap takes the no-probe path.
+        import jax
+
+        jax.devices()
+        ge = self._import_graft_entry()
+        devices = ge._bootstrap_devices(8)
+        self.assertEqual(len(devices), 8)
+
+    def test_bootstrap_devices_raises_when_too_small(self):
+        # With backends initialized, an oversized request must raise
+        # instead of mutating XLA_FLAGS / re-probing.
+        import jax
+
+        jax.devices()
+        ge = self._import_graft_entry()
+        with self.assertRaises(RuntimeError):
+            ge._bootstrap_devices(10**6)
