@@ -110,11 +110,12 @@ def cdist(x: jax.Array, y: jax.Array, *, sqrt: bool = True) -> jax.Array:
         if x.dtype == y.dtype == jnp.float32:
             prod = x @ y.T
         else:
-            # common dtype for dot_general: cast the smaller operand toward
-            # the other's dtype so the array-sized copy is never the big one
-            common = x.dtype if x.size >= y.size else y.dtype
+            # half/mixed dtypes: dot_general reads each operand in its
+            # native dtype and accumulates in f32 — no array-sized upcast
+            # copy of the big operand, and a higher-precision small
+            # operand (f32 centroids against bf16 data) is never downcast
             prod = jax.lax.dot_general(
-                x.astype(common), y.astype(common), (((1,), (1,)), ((), ())),
+                x, y, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
         d2 = jnp.maximum(xsq + ysq - 2.0 * prod, 0.0)

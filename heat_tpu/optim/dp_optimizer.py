@@ -43,6 +43,9 @@ class DataParallelOptimizer:
         if not hasattr(torch_optimizer, "update"):
             raise TypeError("optimizer must be an optax GradientTransformation")
         self.tx = torch_optimizer
+        # attribute-level parity: the reference exposes the wrapped
+        # optimizer as ``self.torch_optimizer`` (dp_optimizer.py:851)
+        self.torch_optimizer = self.tx
         self.blocking = blocking
         self.state = None
         self._model = None

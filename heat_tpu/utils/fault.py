@@ -258,13 +258,16 @@ def run_elastic(
             report.restarts += 1
             emit(report, "failure", step=step, error=repr(exc))
             last_failed_step = step
+            restored = None
             if checkpointer is not None and last_saved is not None:
                 restored = checkpointer.restore_latest(
                     target={"state": init_state, "step": 0}
                 )
+            if restored is not None:
                 state, step = restored["state"], int(restored["step"])
                 emit(report, "restore", step=step)
             else:
+                # checkpoint dir cleaned or save half-failed: rewind to init
                 state, step = init_state, 0
                 emit(report, "rewind", step=0)
             continue

@@ -24,6 +24,10 @@ import os
 import sys
 from typing import Dict, List, Set
 
+# make `python scripts/parity_audit.py` work without pip-installing:
+# the repo root is not on sys.path when the script dir is sys.path[0]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 REFERENCE = os.environ.get("HEAT_REFERENCE_PATH", "/root/reference")
 
 # reference modules whose __all__ lands in the flat ht.* namespace

@@ -76,6 +76,29 @@ class TestFusedCdist(TestCase):
         self.assertEqual(fast.split, 0)
         np.testing.assert_allclose(fast.numpy(), base, atol=1e-4)
 
+    def test_mixed_dtype_never_downcasts_f32_operand(self):
+        """A big bf16 operand paired with a small f32 one must keep the
+        f32 side's precision in the cross term (a downcast-to-bf16 path
+        fails the tight tolerance below)."""
+        import jax.numpy as jnp
+        from heat_tpu.ops.cdist import cdist as _cdist
+
+        rng = np.random.default_rng(5)
+        # x: integers — exactly representable in bf16, so the reference
+        # distance is exact; y: fine-grained f32 values whose mantissa a
+        # bf16 downcast would destroy.
+        x = rng.integers(-8, 8, (64, 8)).astype(np.float32)
+        y = (rng.standard_normal((4, 8)) * (1 + 1e-3)).astype(np.float32)
+        big = jnp.asarray(x).astype(jnp.bfloat16)
+        d_mixed = np.asarray(_cdist(big, jnp.asarray(y)))
+        ref = np.sqrt(
+            np.maximum(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1), 0)
+        )
+        np.testing.assert_allclose(d_mixed, ref, atol=2e-5)
+        # sanity: the downcast path really is distinguishable
+        d_down = np.asarray(_cdist(big, jnp.asarray(y).astype(jnp.bfloat16)))
+        self.assertGreater(np.abs(d_down - ref).max(), 1e-3)
+
     def test_float64_falls_back_to_gspmd(self):
         """Dtype-authoritative fallback: f64 input must not silently degrade."""
         rng = np.random.default_rng(4)

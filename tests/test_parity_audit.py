@@ -2,7 +2,7 @@
 
 scripts/parity_audit.py statically scans the reference's ``__all__`` lists
 (plus estimator class names) and checks each name against this package —
-319 names at last count, all present.  Skipped when the reference tree is
+See docs/PARITY.md for the current name count; all present.  Skipped when the reference tree is
 not mounted (the audit is meaningless without it).
 """
 
