@@ -76,6 +76,17 @@ class _LlocAccessor:
         self._owner[key] = value
 
 
+def _split_axis_shards(phys: jax.Array, split: int):
+    """One shard per split-axis position, in offset order.  Multi-axis
+    meshes replicate over the other axes, so ``addressable_shards`` holds
+    one entry per *device* — duplicates per index that must not be
+    mistaken for distinct chunks."""
+    by_start = {}
+    for sh in phys.addressable_shards:
+        by_start.setdefault(sh.index[split].start or 0, sh)
+    return [by_start[k] for k in sorted(by_start)]
+
+
 def _physical_dim(n: int, nshards: int) -> int:
     """Physical size of a split dimension: the smallest multiple of the shard
     count ≥ n. XLA's GSPMD only represents even tilings at array boundaries,
@@ -279,9 +290,7 @@ class DNDarray:
         if self.__split is None:
             return [np.asarray(self.larray)]
         phys = _to_physical(self.__array, self.__gshape, self.__split, self.__comm)
-        shards = sorted(
-            phys.addressable_shards, key=lambda s: s.index[self.__split].start or 0
-        )
+        shards = _split_axis_shards(phys, self.__split)
         lmap = self.lshape_map
         out = []
         for r, sh in enumerate(shards):
@@ -544,19 +553,7 @@ class DNDarray:
             return key, None
 
         if advanced:
-            # special case: the only non-trivial key is on the split axis and 1-D
-            in_dim = 0
-            only_split_advanced = True
-            for k in key:
-                if k is None:
-                    continue
-                if isinstance(k, (jnp.ndarray, jax.Array, np.ndarray)) and np.ndim(k) > 0:
-                    if in_dim != self.__split or np.ndim(k) != 1:
-                        only_split_advanced = False
-                elif not (isinstance(k, slice) and k == slice(None)):
-                    only_split_advanced = False
-                in_dim += 1
-            return key, (self.__split if only_split_advanced else None)
+            return key, self.__advanced_split(key)
 
         # basic indexing: walk dims
         new_split = None
@@ -580,6 +577,82 @@ class DNDarray:
             # current output cursor plus the remaining gap
             new_split = out_dim + (self.__split - in_dim)
         return key, new_split
+
+    def __advanced_split(self, key) -> Optional[int]:
+        """Split inference for advanced indexing, following NumPy's
+        placement rule: the broadcast advanced block lands at the position
+        of the (contiguous) advanced run, or at the front when basic keys
+        separate the run.  The split survives when no advanced key (and no
+        int, which joins the block) consumes the split dim — its output
+        position is then computable without looking at the data.
+        (Reference: the per-case translation in dndarray.py:779-1035; here
+        inference only picks the output sharding — values come from the
+        global gather either way.)
+        """
+
+        def is_arr(k):
+            return isinstance(k, (jnp.ndarray, jax.Array, np.ndarray)) and np.ndim(k) > 0
+
+        def is_bool_arr(k):
+            return is_arr(k) and np.asarray(k).dtype == bool
+
+        in_dim = 0
+        adv_hits_split = False
+        block_positions = []  # key positions joining the advanced block
+        bcast_nd = 0
+        only_split_1d = True  # legacy fast case: one 1-D key on the split axis
+        for pos, k in enumerate(key):
+            if k is None:
+                continue
+            if is_arr(k):
+                consumed = np.ndim(k) if is_bool_arr(k) else 1
+                if in_dim <= self.__split < in_dim + consumed:
+                    adv_hits_split = True
+                    if np.ndim(k) != 1 or in_dim != self.__split:
+                        only_split_1d = False
+                else:
+                    only_split_1d = False
+                block_positions.append(pos)
+                bcast_nd = max(bcast_nd, 1 if is_bool_arr(k) else np.ndim(k))
+                in_dim += consumed
+            elif isinstance(k, slice):
+                if not (k.start is None and k.stop is None and k.step is None):
+                    only_split_1d = False
+                in_dim += 1
+            else:  # integer: joins the advanced block, contributes no dim
+                only_split_1d = False
+                block_positions.append(pos)
+                if in_dim == self.__split:
+                    adv_hits_split = True
+                in_dim += 1
+        if adv_hits_split:
+            return self.__split if only_split_1d else None
+
+        # split dim survives as a sliced dim; find its output position
+        lo, hi = min(block_positions), max(block_positions)
+        # NumPy: a slice/newaxis between advanced indices pushes the block
+        # to the front; block members are exactly the array/int keys
+        contiguous = all(p in block_positions for p in range(lo, hi + 1))
+        out_pos = 0 if contiguous else bcast_nd
+        in_cursor = 0
+        block_done = not contiguous
+        for pos, k in enumerate(key):
+            if k is None:
+                out_pos += 1
+                continue
+            if isinstance(k, slice) and not is_arr(k):
+                if in_cursor == self.__split:
+                    return out_pos
+                out_pos += 1
+                in_cursor += 1
+                continue
+            # advanced block member (array or int)
+            if not block_done and pos == lo:
+                out_pos += bcast_nd
+                block_done = True
+            in_cursor += np.ndim(k) if is_bool_arr(k) else 1
+        # split dim untouched by the key (implicit trailing slice)
+        return out_pos + (self.__split - in_cursor)
 
     def __getitem__(self, key) -> "DNDarray":
         """Global indexing (reference: dndarray.py:779-1035)."""

@@ -41,6 +41,17 @@ KEYS_2D = [
     np.array([True, False, True, False, True, False, True]),
     (np.array([1, 3]), slice(1, 4)),
     (slice(None), np.array([0, 4])),
+    # mixed basic+advanced, negative ints in arrays, broadcasting pairs
+    (np.array([-1, -7]), slice(None, None, 2)),
+    (np.array([0, 2]), np.array([1, 3])),
+    (np.array([[0], [4]]), np.array([1, 3])),  # broadcast (2,1)x(2,)
+    (2, np.array([0, 2, 4])),  # int joins the advanced block
+    (np.array([1, 5]), 3),
+    (slice(1, 6), np.array([True, False, True, False, True])),  # mask dim1
+    (np.array([True, False, True, False, True, False, True]), 2),
+    (np.array([True, False, True, False, True, False, True]), slice(1, 3)),
+    (None, np.array([0, 3])),  # newaxis + advanced
+    (np.array([0, 3]), None, slice(1, 4)),
 ]
 
 # keys exercised on a (5, 4, 3) 3-D array
@@ -53,6 +64,12 @@ KEYS_3D = [
     (slice(0, 4, 2), Ellipsis, slice(None, None, 2)),
     np.array([0, 4, 2]),
     (slice(None), np.array([0, 3])),
+    # non-contiguous advanced run: block dims move to the front
+    (np.array([0, 2]), slice(None), np.array([0, 2])),
+    (np.array([0, 2]), slice(1, 3), 1),
+    (slice(None), np.array([0, 3]), np.array([0, 2])),
+    (1, np.array([0, 2]), slice(None)),
+    (np.array([[0, 1]]), slice(None), np.array([[0], [2]])),  # bcast (1,2)x(2,1)
 ]
 
 
@@ -161,3 +178,103 @@ class TestSetitemSweep(TestCase):
         self.assertEqual(x.dtype, ht.int32)
         self.assertEqual(x.split, 0)
         self.assertEqual(int(x[0, 0]), 99)
+
+    ADV_SET_CASES = [
+        # (key, value) — advanced setitem classes from the reference's
+        # translation maze (dndarray.py:1498-1788)
+        (np.array([0, 5, 2]), 7.0),
+        (np.array([0, 5, 2]), np.array([[1.0], [2.0], [3.0]], np.float32)),
+        ((np.array([1, 3]), np.array([0, 4])), np.array([9.0, 8.0], np.float32)),
+        ((np.array([1, 3]), slice(1, 4)), -3.0),
+        ((slice(None), np.array([0, 3])), 5.5),
+        ((np.array([[0], [4]]), np.array([1, 3])), 2.25),
+        ((2, np.array([0, 2])), 6.5),
+        (np.array([True, False, True, False, True, False, True]), 0.5),
+        ((np.array([True, False, True, False, True, False, True]), slice(1, 3)), 1.5),
+        ((slice(1, 6), np.array([True, False, True, False, True])), -0.5),
+    ]
+
+    def test_advanced_setitem(self):
+        base = np.arange(35, dtype=np.float32).reshape(7, 5)
+        for split in [None, 0, 1]:
+            for key, val in self.ADV_SET_CASES:
+                data = base.copy()
+                x = ht.array(data, split=split)
+                x[key] = val
+                data[key] = val
+                try:
+                    self.assert_array_equal(x, data)
+                except AssertionError as exc:
+                    raise AssertionError(f"split={split} key={key!r}: {exc}")
+
+    def test_boolean_full_mask_setitem(self):
+        base = np.arange(24, dtype=np.float32).reshape(6, 4)
+        mask = (base % 3) == 0
+        for split in [None, 0, 1]:
+            data = base.copy()
+            x = ht.array(data, split=split)
+            x[ht.array(mask, split=split)] = -1.0
+            data[mask] = -1.0
+            self.assert_array_equal(x, data)
+
+    def test_setitem_broadcasting_value(self):
+        base = np.zeros((7, 5), np.float32)
+        for split in [None, 0, 1]:
+            data = base.copy()
+            x = ht.array(data, split=split)
+            row = np.arange(5, dtype=np.float32)
+            x[2:5] = row  # broadcast (5,) over (3, 5)
+            data[2:5] = row
+            self.assert_array_equal(x, data)
+
+    def test_setitem_negative_step(self):
+        base = np.arange(13, dtype=np.float32)
+        for split in [None, 0]:
+            data = base.copy()
+            x = ht.array(data, split=split)
+            x[::-2] = 0.0
+            data[::-2] = 0.0
+            self.assert_array_equal(x, data)
+
+
+class TestAdvancedSplitInference(TestCase):
+    """Mixed basic+advanced split metadata: the split survives when no
+    advanced (or int) key consumes the split dim, at its NumPy output
+    position (advanced block at the run position, or at the front when the
+    run is separated)."""
+
+    def test_advanced_on_other_dim_keeps_split(self):
+        x = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=0)
+        self.assertEqual(x[:, np.array([0, 2])].split, 0)
+        y = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=1)
+        self.assertEqual(y[np.array([1, 3])].split, 1)
+
+    def test_advanced_on_split_dim_replicates(self):
+        x = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=0)
+        self.assertIsNone(x[np.array([1, 3]), np.array([0, 2])].split)
+
+    def test_only_split_1d_stays_split(self):
+        x = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=0)
+        self.assertEqual(x[np.array([1, 3, 5])].split, 0)
+
+    def test_front_placement_shifts_split(self):
+        # non-contiguous run on a 3-D array: block dims go first
+        x = ht.array(np.arange(60, dtype=np.float32).reshape(5, 4, 3), split=1)
+        got = x[np.array([0, 2]), :, np.array([0, 2])]
+        # output: (block=1 dim) + dim1 → split lands at 1
+        self.assertEqual(got.split, 1)
+        self.assertEqual(got.shape, (2, 4))
+
+    def test_newaxis_before_advanced(self):
+        x = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=0)
+        got = x[None, :, np.array([0, 2])]
+        # output dims: newaxis, dim0(split), block → split at 1
+        self.assertEqual(got.shape, (1, 7, 2))
+        self.assertEqual(got.split, 1)
+
+    def test_int_joins_block(self):
+        x = ht.array(np.arange(60, dtype=np.float32).reshape(5, 4, 3), split=2)
+        got = x[2, np.array([0, 2]), :]
+        # int+array block contiguous at front, then the sliced split dim
+        self.assertEqual(got.shape, (2, 3))
+        self.assertEqual(got.split, 1)
