@@ -4,19 +4,24 @@
 (load_hdf5:57/save_hdf5:149), NetCDF (:268/:351), CSV (:713/:926), plus
 NumPy ``.npy``/``.npz`` as a TPU-first addition (the natural host format for
 JAX).  Feature probes ``supports_hdf5``/``supports_netcdf`` mirror the
-reference.  Each loader reads a per-process slab (``comm.chunk``) and
-assembles the global sharded array with one host→device transfer per shard.
+reference.  Split loads read one slab per device shard (the mesh chunk
+rule) and stitch the global array with
+``jax.make_array_from_single_device_arrays``; split saves write one shard
+slab at a time — in neither direction does the global logical array
+materialize on the host (the reference's MPI-IO slab-per-rank model,
+io.py:57-266, restated for a single controller).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional, Union
+from typing import Callable, Optional, Union
 
+import jax
 import numpy as np
 
 from . import devices, factories, types
-from .dndarray import DNDarray
+from .dndarray import DNDarray, _physical_dim, _split_axis_shards
 from ..parallel.mesh import sanitize_comm
 
 __all__ = [
@@ -66,6 +71,90 @@ def supports_netcdf() -> bool:
     return __NETCDF or __scipy_netcdf is not None
 
 
+def _read_region(source, sel) -> np.ndarray:
+    """All slab reads funnel through here (tests spy on it to prove the
+    loaders never request more than one shard's slab at a time)."""
+    return np.asarray(source[sel])
+
+
+def _write_region(sink, sel, value: np.ndarray) -> None:
+    """All slab writes funnel through here (same test hook as reads)."""
+    sink[sel] = value
+
+
+def _assemble_sharded(
+    read_slab: Callable[[int, int], np.ndarray],
+    gshape,
+    np_dtype,
+    split: int,
+    device,
+    comm,
+) -> DNDarray:
+    """Assemble a split DNDarray from per-shard slabs, one host buffer at a
+    time (reference: io.py:57-147 reads one slab per rank via comm.chunk).
+
+    ``read_slab(lo, hi)`` returns the logical rows ``[lo, hi)`` of the split
+    dim (full extent elsewhere).  Each slab is padded to the even physical
+    chunk, placed on its device, and the global array is stitched with
+    ``jax.make_array_from_single_device_arrays`` — the global logical array
+    never exists on the host.
+    """
+    ndim = len(gshape)
+    split = split % ndim
+    n = gshape[split]
+    phys_shape = list(gshape)
+    phys_shape[split] = _physical_dim(n, comm.size)
+    sharding = comm.sharding(split, ndim)
+    idx_map = sharding.addressable_devices_indices_map(tuple(phys_shape))
+    # group devices by split-axis offset: multi-axis meshes replicate over
+    # the other axes, and each slab must hit the disk only once
+    groups = {}
+    for dev, idx in idx_map.items():
+        start = idx[split].start or 0
+        groups.setdefault(start, (idx, []))[1].append(dev)
+    arrays = []
+    for start, (idx, devs) in groups.items():
+        stop = idx[split].stop
+        stop = phys_shape[split] if stop is None else stop
+        lo, hi = min(start, n), min(stop, n)
+        slab = read_slab(lo, hi)
+        if slab.dtype != np_dtype:
+            slab = slab.astype(np_dtype)
+        if hi - lo < stop - start:
+            pad = [(0, 0)] * ndim
+            pad[split] = (0, (stop - start) - (hi - lo))
+            slab = np.pad(slab, pad)
+        arrays.extend(jax.device_put(slab, dev) for dev in devs)
+    garray = jax.make_array_from_single_device_arrays(
+        tuple(phys_shape), sharding, arrays
+    )
+    return DNDarray(
+        garray,
+        tuple(gshape),
+        types.canonical_heat_type(np_dtype),
+        split,
+        devices.sanitize_device(device),
+        comm,
+    )
+
+
+def _iter_shard_slabs(data: DNDarray):
+    """Yield ``(rank, slices, slab)`` per device shard in split order, one
+    host buffer at a time — the save-side counterpart of
+    :func:`_assemble_sharded` (reference: slab-per-rank writes,
+    io.py:149-266)."""
+    split = data.split
+    shards = _split_axis_shards(data.parray, split)
+    for r, sh in enumerate(shards):
+        _, lshape, slices = data.comm.chunk(data.shape, split, rank=r)
+        if lshape[split] == 0:
+            continue
+        slab = np.asarray(sh.data)
+        sel = [slice(None)] * data.ndim
+        sel[split] = slice(0, lshape[split])
+        yield r, slices, slab[tuple(sel)]
+
+
 def load(path: str, *args, **kwargs) -> DNDarray:
     """Extension-dispatched load (reference: io.py:662)."""
     if not isinstance(path, str):
@@ -98,6 +187,27 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
     raise ValueError(f"unsupported file extension {ext!r}")
 
 
+def _normalize_slices(slices, shape):
+    """Normalize a user ``slices`` argument (slice or tuple of slices, None
+    entries allowed) into one concrete ``slice`` per dim plus the resulting
+    shape."""
+    if not isinstance(slices, tuple):
+        slices = (slices,)
+    if len(slices) > len(shape):
+        raise ValueError(f"too many slices for shape {shape}")
+    norm, out_shape = [], []
+    for d, dim in enumerate(shape):
+        s = slices[d] if d < len(slices) else None
+        if s is None:
+            s = slice(None)
+        if not isinstance(s, slice):
+            raise TypeError(f"slices entries must be slice/None, got {type(s)}")
+        start, stop, step = s.indices(dim)
+        norm.append(slice(start, stop, step))
+        out_shape.append(max(0, -(-(stop - start) // step)))
+    return tuple(norm), tuple(out_shape)
+
+
 def load_hdf5(
     path: str,
     dataset: str,
@@ -107,27 +217,53 @@ def load_hdf5(
     comm=None,
     slices=None,
 ) -> DNDarray:
-    """Parallel HDF5 load (reference: io.py:57 — a slab per rank via
-    comm.chunk, MPI-IO where available)."""
+    """Parallel HDF5 load: one slab per device shard via the mesh chunk
+    rule, assembled with ``jax.make_array_from_single_device_arrays`` — the
+    full dataset is never materialized on the host when ``split`` is given
+    (reference: io.py:57-147, a slab per rank via comm.chunk + MPI-IO)."""
     if not __HDF5:
         raise RuntimeError("h5py is not available")
     comm = sanitize_comm(comm)
+    np_dtype = np.dtype(types.canonical_heat_type(dtype).jax_type())
     with h5py.File(path, "r") as handle:
-        data = handle[dataset]
-        if slices is not None:
-            data = data[slices]
-        else:
-            data = data[...]
-    arr = np.asarray(data)
-    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+        dset = handle[dataset]
+        base, gshape = _normalize_slices(
+            slices if slices is not None else (), dset.shape
+        )
+        if split is None or comm.size == 1 or len(gshape) == 0:
+            arr = _read_region(dset, base)
+            return factories.array(
+                arr, dtype=dtype, split=split, device=device, comm=comm
+            )
+        split_ = split % len(gshape)
+        bs = base[split_]
+
+        def read_slab(lo: int, hi: int) -> np.ndarray:
+            sel = list(base)
+            sel[split_] = slice(bs.start + lo * bs.step, bs.start + hi * bs.step, bs.step)
+            return _read_region(dset, tuple(sel))
+
+        return _assemble_sharded(read_slab, gshape, np_dtype, split_, device, comm)
 
 
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-    """HDF5 save (reference: io.py:149)."""
+    """Parallel HDF5 save: the dataset is created at the global shape and
+    filled one shard slab at a time — no host copy of the global array
+    (reference: io.py:149-266)."""
     if not __HDF5:
         raise RuntimeError("h5py is not available")
+    np_dtype = np.dtype(data.dtype.jax_type())
     with h5py.File(path, mode) as handle:
-        handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+        if dataset in handle:
+            del handle[dataset]
+        dset = handle.create_dataset(
+            dataset, shape=data.shape, dtype=np_dtype, **kwargs
+        )
+        if data.split is None or data.comm.size == 1:
+            _write_region(dset, Ellipsis, data.numpy())
+            return
+        for _, slices, slab in _iter_shard_slabs(data):
+            _write_region(dset, slices, slab)
 
 
 def load_netcdf(
@@ -138,39 +274,107 @@ def load_netcdf(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """NetCDF load (reference: io.py:268)."""
+    """NetCDF load, slab-per-shard along ``split`` like :func:`load_hdf5`
+    (reference: io.py:268)."""
     comm = sanitize_comm(comm)
+    np_dtype = np.dtype(types.canonical_heat_type(dtype).jax_type())
     if __NETCDF:
-        with netCDF4.Dataset(path, "r") as handle:
-            arr = np.asarray(handle.variables[variable][:])
+        opener = lambda: netCDF4.Dataset(path, "r")  # noqa: E731
     elif __scipy_netcdf is not None:
-        with __scipy_netcdf(path, "r", mmap=False) as handle:
-            arr = np.asarray(handle.variables[variable][:])
+        # mmap keeps slab reads lazy for the classic format
+        opener = lambda: __scipy_netcdf(path, "r", mmap=True)  # noqa: E731
     else:
         raise RuntimeError("no NetCDF backend (netCDF4 or scipy) is available")
-    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+    handle = opener()
+    var = read_slab = None
+    try:
+        var = handle.variables[variable]
+        gshape = tuple(var.shape)
+        if split is None or comm.size == 1 or len(gshape) == 0:
+            # np.array: copy out of the mmap before the file closes
+            arr = np.array(_read_region(var, tuple(slice(0, n) for n in gshape)))
+            return factories.array(
+                arr, dtype=dtype, split=split, device=device, comm=comm
+            )
+        split_ = split % len(gshape)
+
+        def read_slab(lo: int, hi: int) -> np.ndarray:
+            sel = tuple(
+                slice(lo, hi) if d == split_ else slice(0, n)
+                for d, n in enumerate(gshape)
+            )
+            return np.array(_read_region(var, sel))
+
+        return _assemble_sharded(read_slab, gshape, np_dtype, split_, device, comm)
+    finally:
+        # scipy's mmap-backed reader warns about lingering views on close;
+        # every slab was copied with np.array above, so the warning is noise
+        import warnings
+
+        var = read_slab = None  # noqa: F841 — drop mmap views before close
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            handle.close()
+
+
+def _netcdf_write_var(var, data: DNDarray) -> None:
+    """Fill a NetCDF variable one shard slab at a time."""
+    if data.split is None or data.comm.size == 1:
+        _write_region(var, tuple(slice(0, n) for n in data.shape) or Ellipsis, data.numpy())
+        return
+    for _, slices, slab in _iter_shard_slabs(data):
+        _write_region(var, slices, slab)
 
 
 def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
-    """NetCDF save (reference: io.py:351)."""
+    """NetCDF save, slab-per-shard writes (reference: io.py:351)."""
+    np_dtype = np.dtype(data.dtype.jax_type())
     if not __NETCDF:
         if __scipy_netcdf is not None and mode == "w":
-            arr = data.numpy()
             with __scipy_netcdf(path, "w") as handle:
-                for i, dim in enumerate(arr.shape):
+                for i, dim in enumerate(data.shape):
                     handle.createDimension(f"dim_{i}", dim)
                 var = handle.createVariable(
-                    variable, arr.dtype.char, tuple(f"dim_{i}" for i in range(arr.ndim))
+                    variable, np_dtype.char, tuple(f"dim_{i}" for i in range(data.ndim))
                 )
-                var[:] = arr
+                _netcdf_write_var(var, data)
             return
         raise RuntimeError("no NetCDF backend (netCDF4 or scipy) is available")
     with netCDF4.Dataset(path, mode) as handle:
-        arr = data.numpy()
-        for i, dim in enumerate(arr.shape):
+        for i, dim in enumerate(data.shape):
             handle.createDimension(f"dim_{i}", dim)
-        var = handle.createVariable(variable, arr.dtype, tuple(f"dim_{i}" for i in range(arr.ndim)))
-        var[:] = arr
+        var = handle.createVariable(
+            variable, np_dtype, tuple(f"dim_{i}" for i in range(data.ndim))
+        )
+        _netcdf_write_var(var, data)
+
+
+def _csv_row_bounds_py(path: str, header_lines: int, nshards: int):
+    """Pure-Python fallback for native.csv_row_bounds: stream the file once
+    recording data-line offsets (blank/comment lines skipped, matching
+    np.genfromtxt), then cut at the even ``ceil(rows/nshards)`` chunk rule."""
+    offsets = []
+    with open(path, "rb") as fh:
+        skipped = 0
+        while skipped < header_lines and fh.readline():
+            skipped += 1
+        pos = fh.tell()
+        for line in fh:
+            body = line.split(b"#", 1)[0].strip()
+            if body:
+                offsets.append(pos)
+            pos += len(line)
+        end = pos
+    rows = len(offsets)
+    per = -(-rows // nshards) if rows else 0
+    bounds = [
+        offsets[min(k * per, rows)] if per and k * per < rows else end
+        for k in range(nshards)
+    ]
+    if rows:
+        bounds[0] = offsets[0]
+    bounds.append(end)
+    return bounds, rows
 
 
 def load_csv(
@@ -183,22 +387,60 @@ def load_csv(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """CSV load (reference: io.py:713 — byte-range splitting per rank there).
+    """CSV load (reference: io.py:713 — per-rank line-aligned byte ranges).
 
-    Parsing goes through the native multi-threaded byte-range parser
-    (heat_tpu/native, the same line-alignment rule as the reference's
-    per-rank ranges) when available, with a NumPy fallback; placement onto
-    the mesh is one sharded device_put either way."""
+    With ``split=0`` the file is cut into one line-aligned byte range per
+    device shard at the mesh chunk rule (native two-pass scan, Python
+    fallback) and each range is parsed and placed independently — host
+    memory stays one slab, matching the reference's slab-per-rank reads.
+    Other splits parse fully (native multi-threaded parser when available)
+    and shard on placement."""
     comm = sanitize_comm(comm)
     np_dtype = np.dtype(types.canonical_heat_type(dtype).jax_type())
-    arr = None
-    if (
+    from .. import native
+
+    native_ok = (
         len(sep) == 1
         and encoding in ("utf-8", "ascii", None)
         and np_dtype == np.float32  # the native parser emits f32 exactly
-    ):
-        from .. import native
+    )
 
+    if split == 0 and comm.size > 1:
+        bounds = (
+            native.csv_row_bounds(path, header_lines, comm.size)
+            if native_ok
+            else None
+        )
+        if bounds is None:
+            bounds = _csv_row_bounds_py(path, header_lines, comm.size)
+        bounds, nrows = bounds
+        if nrows > 1:  # single row squeezes to 1-D; use the full parse below
+            per = -(-nrows // comm.size)
+            # one tiny probe parse for the column count
+            first = _csv_parse_byte_range(
+                path, bounds[0], bounds[-1], sep,
+                np_dtype, encoding, native_ok, probe=True,
+            )
+            ncols = first.shape[1]
+            gshape = (nrows, ncols) if ncols > 1 else (nrows,)
+
+            def read_slab(lo: int, hi: int) -> np.ndarray:
+                if hi <= lo:
+                    return np.empty(
+                        (0, ncols) if ncols > 1 else (0,), dtype=np_dtype
+                    )
+                r = lo // per
+                assert lo == r * per and hi == min((r + 1) * per, nrows)
+                slab = _csv_parse_byte_range(
+                    path, bounds[r], bounds[r + 1], sep, np_dtype, encoding,
+                    native_ok,
+                )
+                return slab if ncols > 1 else slab.reshape(-1)
+
+            return _assemble_sharded(read_slab, gshape, np_dtype, 0, device, comm)
+
+    arr = None
+    if native_ok:
         arr = native.csv_parse(path, header_lines=header_lines, sep=sep)
         if arr is not None:
             arr = np.squeeze(arr)  # match genfromtxt: 1-D for single col/row
@@ -207,6 +449,31 @@ def load_csv(
             path, delimiter=sep, skip_header=header_lines, dtype=np_dtype, encoding=encoding
         )
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def _csv_parse_byte_range(
+    path, start, stop, sep, np_dtype, encoding, native_ok, probe=False
+) -> np.ndarray:
+    """Parse the line-aligned byte range [start, stop) into a 2-D array.
+    ``probe`` parses only the range's first line (column-count sniff)."""
+    if native_ok and not probe:
+        from .. import native
+
+        arr = native.csv_parse_range(path, start, stop, sep=sep)
+        if arr is not None:
+            return arr.astype(np_dtype, copy=False)
+    import io as _io
+
+    with open(path, "rb") as fh:
+        fh.seek(start)
+        # probe: exactly the first line, however long (a 64KB-capped read
+        # would truncate very wide rows and mis-sniff the column count)
+        raw = fh.readline() if probe else fh.read(stop - start)
+    arr = np.genfromtxt(
+        _io.BytesIO(raw), delimiter=sep, dtype=np_dtype,
+        encoding=encoding or "utf-8",
+    )
+    return np.atleast_2d(arr) if arr.ndim < 2 else arr
 
 
 def save_csv(
@@ -222,27 +489,75 @@ def save_csv(
 ) -> None:
     """CSV save (reference: io.py:926).  ``comm`` is accepted for signature
     parity (the write is host-side here); ``truncate=False`` appends."""
-    arr = data.numpy()
     fmt = f"%.{decimals}f" if decimals >= 0 else "%s"
     mode = "w" if truncate else "a"
     # header only at the start of a file — appending must not repeat it
     appending_to_content = mode == "a" and os.path.exists(path) and os.path.getsize(path) > 0
     header = "\n".join(header_lines) if header_lines and not appending_to_content else ""
     with open(path, mode, encoding=encoding, newline="") as fh:
-        np.savetxt(fh, arr, delimiter=sep, fmt=fmt, header=header, comments="")
+        if data.split is None or data.comm.size == 1:
+            np.savetxt(fh, data.numpy(), delimiter=sep, fmt=fmt, header=header, comments="")
+            return
+        if header:
+            fh.write(header + "\n")
+        if data.split != 0:
+            # row-major text wants row blocks: reshard onto rows first
+            from .manipulations import resplit
+
+            data = resplit(data, 0)
+        # one shard slab at a time — never the global array
+        for _, _, slab in _iter_shard_slabs(data):
+            np.savetxt(fh, slab, delimiter=sep, fmt=fmt)
 
 
 def load_npy(path: str, dtype=None, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
-    """NumPy .npy/.npz load (TPU-first addition)."""
-    arr = np.load(path)
-    if isinstance(arr, np.lib.npyio.NpzFile):
-        arr = arr[arr.files[0]]
+    """NumPy .npy/.npz load (TPU-first addition).  ``.npy`` with a split
+    reads one memory-mapped slab per shard; the global array never lands on
+    the host."""
+    comm = sanitize_comm(comm)
+    if path.endswith(".npy"):
+        arr = np.load(path, mmap_mode="r")
+        gshape = tuple(arr.shape)
+        if split is not None and comm.size > 1 and len(gshape) > 0:
+            split_ = split % len(gshape)
+            np_dtype = (
+                arr.dtype
+                if dtype is None
+                else np.dtype(types.canonical_heat_type(dtype).jax_type())
+            )
+
+            def read_slab(lo: int, hi: int) -> np.ndarray:
+                sel = tuple(
+                    slice(lo, hi) if d == split_ else slice(0, n)
+                    for d, n in enumerate(gshape)
+                )
+                return np.array(_read_region(arr, sel))
+
+            return _assemble_sharded(read_slab, gshape, np_dtype, split_, device, comm)
+        arr = np.array(arr)
+    else:
+        arr = np.load(path)
+        if isinstance(arr, np.lib.npyio.NpzFile):
+            arr = arr[arr.files[0]]
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 
 def save_npy(data: DNDarray, path: str) -> None:
-    """NumPy .npy save (TPU-first addition)."""
-    np.save(path, data.numpy())
+    """NumPy .npy save (TPU-first addition).  Split arrays stream one shard
+    slab at a time into a memory-mapped destination."""
+    if data.split is None or data.comm.size == 1:
+        np.save(path, data.numpy())
+        return
+    np_dtype = np.dtype(data.dtype.jax_type())
+    out = np.lib.format.open_memmap(
+        path, mode="w+", dtype=np_dtype, shape=data.shape
+    )
+    try:
+        for _, slices, slab in _iter_shard_slabs(data):
+            _write_region(out, slices, slab)
+        out.flush()
+    finally:
+        del out
 
 
 DNDarray.save = lambda self, path, *args, **kwargs: save(self, path, *args, **kwargs)

@@ -28,6 +28,8 @@ __all__ = [
     "available",
     "lib",
     "csv_parse",
+    "csv_parse_range",
+    "csv_row_bounds",
     "read_bytes",
     "threefry_fill",
     "threefry_permutation",
@@ -96,6 +98,18 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
             ctypes.POINTER(ctypes.c_long),
         ]
+        lib.ht_csv_parse_range.restype = ctypes.c_long
+        lib.ht_csv_parse_range.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_char,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.ht_csv_row_bounds.restype = ctypes.c_long
+        lib.ht_csv_row_bounds.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
         lib.ht_read_bytes.restype = ctypes.c_long
         lib.ht_read_bytes.argtypes = [
             ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p,
@@ -162,6 +176,53 @@ def csv_parse(path: str, header_lines: int = 0, sep: str = ",") -> Optional[np.n
     try:
         if rows.value == 0:
             return None
+        arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
+    finally:
+        l.ht_free(out)
+    return arr.reshape(rows.value, n // rows.value)
+
+
+def csv_row_bounds(path: str, header_lines: int, nshards: int):
+    """Shard row-boundaries for an even ``ceil(rows/nshards)`` partition of
+    the file's data rows (the mesh chunk rule): returns
+    ``(bounds, nrows)`` where ``bounds[k]:bounds[k+1]`` is shard ``k``'s
+    line-aligned byte range.  None when native is unavailable or the scan
+    fails."""
+    l = _load()
+    if l is None:
+        return None
+    bounds = (ctypes.c_long * (nshards + 1))()
+    nrows = ctypes.c_long()
+    ret = l.ht_csv_row_bounds(
+        path.encode(), header_lines, nshards, bounds, ctypes.byref(nrows)
+    )
+    if ret != 0:
+        return None
+    return list(bounds), nrows.value
+
+
+def csv_parse_range(
+    path: str, start: int, end: int, sep: str = ","
+) -> Optional[np.ndarray]:
+    """Parse the line-aligned byte range [start, end) into a float32
+    (rows, cols) array.  None on error/ragged rows; shape (0, 0) array for
+    an empty range."""
+    l = _load()
+    if l is None:
+        return None
+    if end <= start:
+        return np.empty((0, 0), dtype=np.float32)
+    out = ctypes.POINTER(ctypes.c_float)()
+    rows = ctypes.c_long()
+    n = l.ht_csv_parse_range(
+        path.encode(), start, end, sep.encode()[:1], _DEFAULT_THREADS,
+        ctypes.byref(out), ctypes.byref(rows),
+    )
+    if n < 0:
+        return None
+    if rows.value == 0:
+        return np.empty((0, 0), dtype=np.float32)
+    try:
         arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
     finally:
         l.ht_free(out)

@@ -106,6 +106,29 @@ void parse_chunk(const char* data, long n, char delim,
   *cols = ncols < 0 ? 0 : ncols;
 }
 
+// Skip header_lines lines from the start of the file; returns the byte
+// offset of the first data line.
+long skip_header(int fd, long header_lines, long fsize) {
+  long data_start = 0;
+  char buf[1 << 16];
+  long remaining = header_lines;
+  while (remaining > 0 && data_start < fsize) {
+    ssize_t got = pread(fd, buf, sizeof(buf), data_start);
+    if (got <= 0) break;
+    long i = 0;
+    for (; i < got && remaining > 0; ++i)
+      if (buf[i] == '\n') --remaining;
+    data_start += i;
+  }
+  return data_start;
+}
+
+// Parse the line-aligned span [data_start, fsize) of an open file.  Same
+// contract as ht_csv_parse below (which delegates here after the header
+// skip).
+long csv_parse_span(int fd, long data_start, long fsize, char delim,
+                    int nthreads, float** out_data, long* out_rows);
+
 }  // namespace
 
 extern "C" {
@@ -130,22 +153,112 @@ long ht_csv_parse(const char* path, long header_lines, char delim,
     return -1;
   }
   long fsize = st.st_size;
+  long data_start = skip_header(fd, header_lines, fsize);
+  long ret = csv_parse_span(fd, data_start, fsize, delim, nthreads, out_data,
+                            out_rows);
+  close(fd);
+  return ret;
+}
 
-  // skip header lines
-  long data_start = 0;
-  {
-    char buf[1 << 16];
-    long remaining = header_lines;
-    while (remaining > 0 && data_start < fsize) {
-      ssize_t got = pread(fd, buf, sizeof(buf), data_start);
+// Parse only the byte range [start, end) — already line-aligned, header
+// excluded (the slab-per-shard loader gets its bounds from
+// ht_csv_row_bounds).  Same return contract as ht_csv_parse.
+long ht_csv_parse_range(const char* path, long start, long end, char delim,
+                        int nthreads, float** out_data, long* out_rows) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (end < 0 || end > st.st_size) end = st.st_size;
+  if (start < 0) start = 0;
+  if (start > end) start = end;
+  long ret = csv_parse_span(fd, start, end, delim, nthreads, out_data,
+                            out_rows);
+  close(fd);
+  return ret;
+}
+
+// Byte offsets of the shard row-boundaries for an even ceil(rows/nshards)
+// partition of the file's data rows (the mesh chunk rule).  Writes
+// nshards+1 offsets into out_bounds (bounds[k] = start of data row
+// k*ceil(rows/nshards), clamped; bounds[nshards] = end of data) and the
+// total data-row count into out_rows.  A row is counted iff it has any
+// non-whitespace content before '#' — the same rule parse_chunk uses to
+// skip blank/comment lines.  Returns 0 on success, -1 on error.
+long ht_csv_row_bounds(const char* path, long header_lines, long nshards,
+                       long* out_bounds, long* out_rows) {
+  if (nshards < 1) return -1;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  long fsize = st.st_size;
+  long data_start = skip_header(fd, header_lines, fsize);
+
+  // streaming two-pass scan; line state survives buffer boundaries
+  std::vector<char> buf(16 << 20);
+  for (int pass = 0; pass < 2; ++pass) {
+    long rows = pass == 0 ? 0 : *out_rows;
+    long per = pass == 0 ? 0 : (rows + nshards - 1) / nshards;
+    long row_idx = 0;
+    long next_shard = 0;  // bounds[0] = first data row's line start
+    long line_start = data_start;
+    bool in_comment = false;
+    bool counted = false;  // current line already counted as a data row
+    long pos = data_start;
+    if (pass == 1 && per == 0) {  // no data rows: every shard is empty
+      while (next_shard <= nshards) out_bounds[next_shard++] = fsize;
+      continue;
+    }
+    while (pos < fsize) {
+      ssize_t got = pread(fd, buf.data(), buf.size(), pos);
       if (got <= 0) break;
-      long i = 0;
-      for (; i < got && remaining > 0; ++i)
-        if (buf[i] == '\n') --remaining;
-      data_start += i;
+      for (long i = 0; i < got; ++i) {
+        char c = buf[i];
+        if (c == '\n') {
+          line_start = pos + i + 1;
+          in_comment = false;
+          counted = false;
+        } else if (c == '#') {
+          in_comment = true;
+        } else if (!counted && !in_comment && c != ' ' && c != '\t' &&
+                   c != '\r') {
+          // first content character: this line is data row row_idx
+          if (pass == 1) {
+            while (next_shard < nshards && next_shard * per == row_idx) {
+              out_bounds[next_shard] = line_start;
+              ++next_shard;
+            }
+          }
+          ++row_idx;
+          counted = true;
+        }
+      }
+      pos += got;
+    }
+    if (pass == 0) {
+      *out_rows = row_idx;
+    } else {
+      // shards starting at or past the end of the data, plus the final bound
+      while (next_shard <= nshards) out_bounds[next_shard++] = fsize;
     }
   }
+  close(fd);
+  return 0;
+}
 
+}  // extern "C"
+
+namespace {
+
+long csv_parse_span(int fd, long data_start, long fsize, char delim,
+                    int nthreads, float** out_data, long* out_rows) {
   long span = fsize - data_start;
   if (nthreads < 1) nthreads = 1;
   if (span < (1 << 20)) nthreads = 1;  // small file: one thread
@@ -183,7 +296,6 @@ long ht_csv_parse(const char* path, long header_lines, char delim,
     });
   }
   for (auto& w : workers) w.join();
-  close(fd);
 
   // uniform column count across every chunk, else signal ragged (-2)
   long ncols = -1;
@@ -210,6 +322,10 @@ long ht_csv_parse(const char* path, long header_lines, char delim,
   *out_rows = trows;
   return total;
 }
+
+}  // namespace
+
+extern "C" {
 
 // Multi-threaded chunked binary read into caller buffer.
 long ht_read_bytes(const char* path, long offset, long size, void* buf,

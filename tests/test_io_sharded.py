@@ -1,0 +1,284 @@
+"""Sharded I/O: slab-per-shard loads and saves (heat_tpu/core/io.py).
+
+The reference reads one slab per rank via ``comm.chunk`` + MPI-IO
+(heat/core/io.py:57-266).  The TPU-native equivalent assembles per-device
+slabs with ``jax.make_array_from_single_device_arrays`` and writes shard by
+shard.  These tests spy on the module's ``_read_region``/``_write_region``
+funnels to prove the global array is never materialized on the host: every
+region request must be at most one physical shard's extent on the split dim.
+"""
+
+import contextlib
+import os
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core import io as htio
+from .base import TestCase
+
+
+@contextlib.contextmanager
+def _spy_regions():
+    """Record the split-dim extents requested through the io region funnels."""
+    reads, writes = [], []
+    orig_read, orig_write = htio._read_region, htio._write_region
+
+    def spy_read(source, sel):
+        reads.append(sel)
+        return orig_read(source, sel)
+
+    def spy_write(sink, sel, value):
+        writes.append(np.asarray(value).shape)
+        return orig_write(sink, sel, value)
+
+    htio._read_region, htio._write_region = spy_read, spy_write
+    try:
+        yield reads, writes
+    finally:
+        htio._read_region, htio._write_region = orig_read, orig_write
+
+
+def _extent(sel, dim, total):
+    s = sel[dim] if isinstance(sel, tuple) else sel
+    if not isinstance(s, slice):
+        return total
+    start, stop, step = s.indices(total)
+    return max(0, -(-(stop - start) // step))
+
+
+class TestShardedHDF5(TestCase):
+    def _roundtrip(self, shape, split, dtype=np.float32):
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal(shape).astype(dtype)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.h5")
+            x = ht.array(A, split=split)
+            with _spy_regions() as (reads, writes):
+                ht.save(x, path, "DATA")
+                y = ht.load(path, dataset="DATA", split=split)
+            np.testing.assert_allclose(y.numpy(), A, rtol=1e-6)
+            self.assertEqual(y.split, split)
+            return reads, writes
+
+    def test_roundtrip_split0_odd_shape(self):
+        n, size = 13, ht.communication.MPI_WORLD.size
+        per = -(-n // size)
+        reads, writes = self._roundtrip((n, 5), 0)
+        # every slab request bounded by one shard's chunk
+        self.assertTrue(reads and writes)
+        self.assertTrue(all(_extent(sel, 0, n) <= per for sel in reads))
+        self.assertTrue(all(shape[0] <= per for shape in writes))
+
+    def test_roundtrip_split1(self):
+        m = 7
+        size = ht.communication.MPI_WORLD.size
+        per = -(-m // size)
+        reads, writes = self._roundtrip((6, m), 1)
+        self.assertTrue(all(_extent(sel, 1, m) <= per for sel in reads))
+        self.assertTrue(all(shape[1] <= per for shape in writes))
+
+    def test_roundtrip_empty_shards(self):
+        # 3 rows over 8 devices: most shards empty
+        self._roundtrip((3, 4), 0)
+
+    def test_roundtrip_replicated(self):
+        self._roundtrip((5, 4), None)
+
+    def test_load_with_slices(self):
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((20, 6)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.h5")
+            ht.save(ht.array(A), path, "DATA")
+            y = htio.load_hdf5(path, "DATA", split=0, slices=(slice(3, 17, 2),))
+            np.testing.assert_allclose(y.numpy(), A[3:17:2], rtol=1e-6)
+            z = htio.load_hdf5(path, "DATA", split=0, slices=(None, slice(1, 4)))
+            np.testing.assert_allclose(z.numpy(), A[:, 1:4], rtol=1e-6)
+
+    def test_save_append_mode_replaces_dataset(self):
+        A = np.arange(12, dtype=np.float32).reshape(4, 3)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.h5")
+            ht.save(ht.array(A, split=0), path, "DATA")
+            ht.save(ht.array(A * 2, split=0), path, "DATA", mode="a")
+            y = ht.load(path, dataset="DATA", split=0)
+            np.testing.assert_allclose(y.numpy(), A * 2, rtol=1e-6)
+
+    def test_docstring_matches_behavior(self):
+        # round-1 review: the docstring advertised slab loading while the
+        # body read the whole dataset — keep them honest
+        self.assertIn("slab", htio.load_hdf5.__doc__.lower())
+        self.assertNotIn("whole", htio.load_hdf5.__doc__.lower())
+
+
+class TestShardedNpy(TestCase):
+    def test_roundtrip_split0(self):
+        rng = np.random.default_rng(2)
+        A = rng.standard_normal((11, 3)).astype(np.float32)
+        size = ht.communication.MPI_WORLD.size
+        per = -(-11 // size)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.npy")
+            with _spy_regions() as (reads, writes):
+                ht.save(ht.array(A, split=0), path)
+                y = ht.load(path, split=0)
+            np.testing.assert_allclose(y.numpy(), A)
+            self.assertEqual(y.split, 0)
+            self.assertTrue(all(_extent(sel, 0, 11) <= per for sel in reads))
+            self.assertTrue(all(shape[0] <= per for shape in writes))
+
+    def test_dtype_override(self):
+        A = np.arange(10, dtype=np.float64)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.npy")
+            ht.save(ht.array(A, split=0), path)
+            y = ht.load(path, split=0, dtype=ht.float32)
+            self.assertEqual(y.dtype, ht.float32)
+
+
+class TestShardedCSV(TestCase):
+    def test_roundtrip_split0(self):
+        rng = np.random.default_rng(3)
+        A = (rng.standard_normal((13, 5)) * 10).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.csv")
+            ht.save(ht.array(A, split=0), path)
+            y = ht.load(path, split=0)
+            np.testing.assert_allclose(y.numpy(), A, atol=1e-4)
+            self.assertEqual(y.split, 0)
+
+    def test_save_nonzero_split_streams_rows(self):
+        rng = np.random.default_rng(4)
+        A = rng.standard_normal((9, 4)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.csv")
+            ht.save(ht.array(A, split=1), path)
+            np.testing.assert_allclose(
+                np.genfromtxt(path, delimiter=","), A, atol=1e-4
+            )
+
+    def test_header_comments_blank_lines(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.csv")
+            with open(path, "w") as f:
+                f.write("c1,c2\n1,2\n\n# note\n3,4\n5,6\n  \n7,8\n")
+            y = ht.load(path, header_lines=1, split=0)
+            np.testing.assert_allclose(
+                y.numpy(), [[1, 2], [3, 4], [5, 6], [7, 8]]
+            )
+
+    def test_native_and_python_bounds_agree(self):
+        from heat_tpu import native
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.csv")
+            with open(path, "w") as f:
+                f.write("h\n")
+                for i in range(23):
+                    f.write(f"{i},{i * 2}\n")
+                    if i % 5 == 0:
+                        f.write("# interleaved comment\n")
+            py_bounds, py_rows = htio._csv_row_bounds_py(path, 1, 8)
+            self.assertEqual(py_rows, 23)
+            if native.available():
+                nat = native.csv_row_bounds(path, 1, 8)
+                self.assertIsNotNone(nat)
+                self.assertEqual(list(nat[0]), list(py_bounds))
+                self.assertEqual(nat[1], py_rows)
+
+    def test_python_fallback_path(self):
+        # non-f32 dtype forces the pure-Python slab parser
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal((10, 3))
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.csv")
+            np.savetxt(path, A, delimiter=",", fmt="%.10f")
+            y = ht.load(path, split=0, dtype=ht.float64)
+            self.assertEqual(y.dtype, ht.float64)
+            np.testing.assert_allclose(y.numpy(), A, atol=1e-9)
+
+    def test_single_column(self):
+        A = np.arange(12, dtype=np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.csv")
+            np.savetxt(path, A, delimiter=",")
+            y = ht.load(path, split=0)
+            self.assertEqual(y.shape, (12,))
+            np.testing.assert_allclose(y.numpy(), A, atol=1e-5)
+
+
+class TestShardedNetCDF(TestCase):
+    def test_roundtrip_split0(self):
+        if not htio.supports_netcdf():
+            self.skipTest("no netcdf backend")
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal((13, 4)).astype(np.float32)
+        size = ht.communication.MPI_WORLD.size
+        per = -(-13 // size)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.nc")
+            with _spy_regions() as (reads, writes):
+                ht.save(ht.array(A, split=0), path, "VAR")
+                y = ht.load(path, variable="VAR", split=0)
+            np.testing.assert_allclose(y.numpy(), A, rtol=1e-6)
+            self.assertEqual(y.split, 0)
+            self.assertTrue(all(_extent(sel, 0, 13) <= per for sel in reads))
+            self.assertTrue(all(shape[0] <= per for shape in writes))
+
+
+class TestReviewRegressions(TestCase):
+    def test_csv_leading_comment_after_header(self):
+        """The column-count probe must land on the first data row, not a
+        comment/blank line after the header."""
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.csv")
+            with open(path, "w") as f:
+                f.write("h1\n# leading comment\n")
+                for i in range(12):
+                    f.write(f"{i},{i * 2}\n")
+            y = ht.load(path, header_lines=1, split=0)
+            self.assertEqual(y.shape, (12, 2))
+            np.testing.assert_allclose(y.numpy()[:, 1], 2 * y.numpy()[:, 0])
+
+    def test_save_on_multi_axis_mesh(self):
+        """addressable_shards holds one entry per device; on a 2-axis mesh
+        replicas must not be mistaken for distinct split-axis shards."""
+        import jax
+        from jax.sharding import Mesh
+
+        from heat_tpu.parallel.mesh import MeshComm
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        comm = MeshComm(mesh, split_axis="ici")
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((13, 5)).astype(np.float32)
+        x = ht.array(A, split=0, comm=comm)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.h5")
+            ht.save(x, path, "D")
+            back = ht.load(path, dataset="D")
+            np.testing.assert_allclose(back.numpy(), A, rtol=1e-6)
+        # lshards shares the dedup: 4 split-axis shards covering all rows
+        shards = x.lshards()
+        self.assertEqual(len(shards), 4)
+        self.assertEqual(sum(s.shape[0] for s in shards), 13)
+
+    def test_unique_on_multi_axis_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from heat_tpu.parallel.mesh import MeshComm
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        comm = MeshComm(mesh, split_axis="ici")
+        D = np.random.default_rng(1).integers(0, 5, 23).astype(np.int32)
+        u = ht.unique(ht.array(D, split=0, comm=comm))
+        np.testing.assert_array_equal(u.numpy(), np.unique(D))
+
+    def test_unique_collapses_nans_across_shards(self):
+        E = np.random.default_rng(2).standard_normal(30).astype(np.float32)
+        E[5:20] = np.nan
+        u = ht.unique(ht.array(E, split=0))
+        self.assertEqual(int(np.isnan(u.numpy()).sum()), 1)
