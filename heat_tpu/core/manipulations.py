@@ -13,6 +13,8 @@ statically).
 
 from __future__ import annotations
 
+import builtins
+
 from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -308,15 +310,51 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Sort along an axis; returns (sorted, original indices) like the
-    reference (manipulations.py:2261 — a hand-written distributed sample sort
-    there; XLA's partitioned sort here)."""
+    reference (manipulations.py:2261 — a hand-written sample sort with ragged
+    Alltoallv there).
+
+    When the sorted axis is the split axis, a block odd-even merge-split
+    network over the mesh does the sort (``parallel/sort.py``): only
+    collective-permutes of one shard block per round, never an all-gather of
+    the data axis, so sorting scales past one device's memory.  Other axes
+    sort locally per shard.
+    """
     sanitation.sanitize_in(a)
     axis = stride_tricks.sanitize_axis(a.shape, axis)
-    arr = a.larray
-    indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
-    values = jnp.take_along_axis(arr, indices, axis=axis)
-    v = _wrap(values, a, a.split)
-    i = _wrap(indices, a, a.split)
+    if a.split == axis and a.comm.size > 1 and a.is_distributed():
+        from ..parallel.sort import distributed_sort
+
+        arr = a.parray
+        if descending:
+            # sort a monotone-decreasing transform of the keys instead of
+            # flipping the ascending result: a flip would reverse tie
+            # order, making duplicate-value indices differ from the
+            # single-device stable descending path (mesh-invariance).
+            # Floats negate (NaNs stay NaN → still ordered last); ints and
+            # bools use bitwise NOT (~k = -k-1, bijective, no INT_MIN
+            # overflow).
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                arr, undo = -arr, lambda v: -v
+            elif arr.dtype == jnp.bool_:
+                arr, undo = ~arr, lambda v: ~v
+            else:
+                arr, undo = jnp.invert(arr), jnp.invert
+        values, indices = distributed_sort(
+            arr, a.comm.mesh, a.comm.split_axis, axis, a.shape[axis]
+        )
+        if descending:
+            values = undo(values)
+        v = DNDarray(values, a.shape, a.dtype, a.split, a.device, a.comm)
+        i = DNDarray(
+            indices, a.shape, types.canonical_heat_type(indices.dtype),
+            a.split, a.device, a.comm,
+        )
+    else:
+        arr = a.larray
+        indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
+        values = jnp.take_along_axis(arr, indices, axis=axis)
+        v = _wrap(values, a, a.split)
+        i = _wrap(indices, a, a.split)
     if out is not None:
         out.larray = v.larray
         return out, i
@@ -446,8 +484,59 @@ def mpi_topk(a, b, dim: int = -1, largest: bool = True, sorted: bool = True):
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis=None):
     """Unique elements (reference: manipulations.py:3048 — local unique +
     gather + re-unique there). Result is replicated: its size is data-
-    dependent."""
+    dependent.
+
+    A split 1-D input goes through the distributed merge-split sort
+    (parallel/sort.py) first, then per-shard compaction: the host only ever
+    holds one sorted shard slab plus the uniques themselves — never the full
+    data axis (the reference's local-unique-then-gather memory profile).
+    """
     sanitation.sanitize_in(a)
+    if (
+        axis is None
+        and a.ndim == 1
+        and a.split == 0
+        and a.comm.size > 1
+        and a.is_distributed()
+    ):
+        sv, _ = sort(a, axis=0)
+        phys = sv.parray
+        n = a.shape[0]
+        per = phys.shape[0] // a.comm.size
+        from .dndarray import _split_axis_shards
+
+        shards = _split_axis_shards(phys, 0)
+        parts, prev_last = [], None
+        is_float = np.issubdtype(np.dtype(a.dtype.jax_type()), np.floating)
+        for r, sh in enumerate(shards):
+            valid = builtins.min(builtins.max(n - r * per, 0), per)
+            if valid == 0:
+                break
+            slab = np.unique(np.asarray(sh.data)[:valid])
+            if prev_last is not None and slab.size:
+                dup = slab[0] == prev_last or (
+                    is_float and np.isnan(slab[0]) and np.isnan(prev_last)
+                )
+                if dup:
+                    slab = slab[1:]
+            if slab.size:
+                parts.append(slab)
+                prev_last = slab[-1]
+        np_dtype = np.dtype(a.dtype.jax_type())
+        uni = np.concatenate(parts) if parts else np.empty(0, dtype=np_dtype)
+        vals = jnp.asarray(uni)
+        v = DNDarray(
+            vals, tuple(vals.shape), types.canonical_heat_type(vals.dtype),
+            None, a.device, a.comm,
+        )
+        if return_inverse:
+            inverse = jnp.searchsorted(vals, a.larray)
+            inv = DNDarray(
+                inverse, tuple(inverse.shape),
+                types.canonical_heat_type(inverse.dtype), None, a.device, a.comm,
+            )
+            return v, inv
+        return v
     if return_inverse:
         vals, inverse = jnp.unique(a.larray, return_inverse=True, axis=axis)
         v = DNDarray(vals, tuple(vals.shape), types.canonical_heat_type(vals.dtype), None, a.device, a.comm)

@@ -230,28 +230,115 @@ def randint(low, high=None, size=None, dtype=types.int32, split=None, device=Non
 random_integer = randint
 
 
+def _perm_sort_keys(n: int, device, comm) -> DNDarray:
+    """Split-invariant random sort keys for a sharded permutation: sorting
+    them is the TPU replacement for Fisher–Yates — the reference keeps
+    randperm distributed through its counter sequence (random.py:55-201,649);
+    here a seeded draw plus the distributed merge-split sort
+    (parallel/sort.py) do the same without ever replicating the n values.
+
+    The keys are a keyed 8-round Feistel **bijection** of the element index
+    over 32 bits, not independent random draws: independent int32 keys
+    collide (birthday: ~1.1e6 pairs at n=1e8) and every collision falls
+    back to the sort's ascending-index tiebreak — a measurable bias.  A
+    bijection has no ties, so the induced permutation is exactly the sort
+    order of a pseudorandom injection, and it stays a pure function of
+    (seed, index) — mesh-size invariant like every other sampler here.
+    """
+    rk = np.asarray(jax.random.bits(__next_key(), (8,), "uint32"))
+
+    def sampler(key, shape, dtype):
+        i = jnp.arange(shape[0], dtype=jnp.uint32)
+        left, right = i >> 16, i & jnp.uint32(0xFFFF)
+        for round_key in rk:
+            f = right * jnp.uint32(0x9E3779B9) ^ jnp.uint32(int(round_key))
+            f = (f >> 13) & jnp.uint32(0xFFFF)
+            left, right = right, left ^ f
+        # bitcast, not astype: int32 convert of values >= 2^31 is not a
+        # bit-preserving map, which would break the bijection
+        return jax.lax.bitcast_convert_type((left << 16) | right, jnp.int32)
+
+    return _sharded_sample((int(n),), 0, device, comm, sampler, jnp.int32)
+
+
 def randperm(n: int, dtype=None, split=None, device=None, comm=None) -> DNDarray:
     """Random permutation of arange(n) (reference: random.py:649 defaults to
-    int64; here the default follows the x64 mode so TPU runs stay int32)."""
-    key = __next_key()
+    int64; here the default follows the x64 mode so TPU runs stay int32).
+
+    With ``split=0`` on a multi-device mesh the permutation is built
+    *sharded* — random keys drawn per shard and distributed-sorted, no
+    device ever holding all n entries (the 1e8-row epoch shuffle case)."""
     comm_ = sanitize_comm(comm)
     if dtype is None:
         dtype = types.int64 if jax.config.jax_enable_x64 else types.int32
-    perm = jax.random.permutation(key, int(n)).astype(types.canonical_heat_type(dtype).jax_type())
+    jdtype = types.canonical_heat_type(dtype).jax_type()
+    if split == 0 and comm_.size > 1 and int(n) >= comm_.size:
+        from ..parallel.sort import distributed_sort
+
+        keys = _perm_sort_keys(n, device, comm_)
+        _, idx = distributed_sort(
+            keys.parray, comm_.mesh, comm_.split_axis, 0, int(n)
+        )
+        return DNDarray(
+            idx.astype(jdtype), (int(n),), types.canonical_heat_type(dtype),
+            0, devices.sanitize_device(device), comm_,
+        )
+    key = __next_key()
+    perm = jax.random.permutation(key, int(n)).astype(jdtype)
     return _finalize(perm, split, device, comm_)
+
+
+def shuffle_rows(arrays, device=None):
+    """Shuffle several split=0 DNDarrays along axis 0 with one shared random
+    permutation, fully sharded (the epoch shuffle of the data layer;
+    reference: dataset_shuffle's Alltoall, utils/data/datatools.py:246).
+    Every array's rows ride the distributed sort as payload blocks — only
+    shard-sized slabs ever move, via collective-permute."""
+    arrays = list(arrays)
+    if not arrays:
+        return []
+    lead = arrays[0]
+    n = lead.shape[0]
+    comm = lead.comm
+    if any(a.shape[0] != n or a.split != 0 for a in arrays):
+        raise ValueError("shuffle_rows needs split=0 arrays with equal leading dim")
+    if comm.size == 1 or not lead.is_distributed() or n < comm.size:
+        perm = randperm(n, comm=comm, device=device)
+        out = []
+        for a in arrays:
+            shuffled = a.larray[perm.larray]
+            out.append(DNDarray(shuffled, a.shape, a.dtype, a.split, a.device, a.comm))
+        from .dndarray import _ensure_split
+
+        return [_ensure_split(o, o.split) for o in out]
+    from ..parallel.sort import distributed_sort
+
+    keys = _perm_sort_keys(n, device, comm)
+    res = distributed_sort(
+        keys.parray, comm.mesh, comm.split_axis, 0, int(n),
+        payloads=tuple(a.parray for a in arrays),
+    )
+    return [
+        DNDarray(p, a.shape, a.dtype, a.split, a.device, a.comm)
+        for p, a in zip(res[2:], arrays)
+    ]
 
 
 def permutation(x, split=None, device=None, comm=None) -> DNDarray:
     """Randomly permute a sequence or shuffle an array along axis 0
-    (reference: random.py:326)."""
-    key = __next_key()
+    (reference: random.py:326).  Split=0 DNDarrays shuffle sharded (rows
+    ride the distributed sort; no replication)."""
     if isinstance(x, (int, np.integer)):
         return randperm(int(x), split=split, device=device, comm=comm)
     if isinstance(x, DNDarray):
+        if x.split == 0 and x.comm.size > 1 and x.is_distributed() and x.shape[0] >= x.comm.size:
+            return shuffle_rows([x], device=device)[0]
+        key = __next_key()
         shuffled = jax.random.permutation(key, x.larray, axis=0)
         out = DNDarray(shuffled, x.shape, x.dtype, x.split, x.device, x.comm)
         from .dndarray import _ensure_split
 
         return _ensure_split(out, x.split)
+    key = __next_key()
     arr = jnp.asarray(x)
     return _finalize(jax.random.permutation(key, arr, axis=0), split, device, sanitize_comm(comm))

@@ -248,15 +248,68 @@ def minimum(x1, x2, out=None, where=None) -> DNDarray:
     return _operations._binary_op(jnp.minimum, x1, x2, out=out, where=where)
 
 
+def _percentile_of_sorted(sv, q, axis: int, n: int, method: str, keepdims: bool):
+    """Select percentiles from an already (distributed-)sorted axis: only
+    O(len(q)) slices are gathered, never the data axis."""
+    q_arr = jnp.asarray(q, jnp.float32)
+    scalar_q = q_arr.ndim == 0
+    pos = q_arr / 100.0 * (n - 1)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, n - 1)
+    hi = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, n - 1)
+    if method == "lower":
+        out = jnp.take(sv, lo, axis=axis)
+    elif method == "higher":
+        out = jnp.take(sv, hi, axis=axis)
+    elif method == "nearest":
+        out = jnp.take(sv, jnp.round(pos).astype(jnp.int32), axis=axis)
+    else:
+        vlo = jnp.take(sv, lo, axis=axis)
+        vhi = jnp.take(sv, hi, axis=axis)
+        if method == "midpoint":
+            out = (vlo + vhi) / 2
+        else:  # linear
+            frac = (pos - lo).reshape(
+                (1,) * axis + q_arr.shape + (1,) * (sv.ndim - axis - 1)
+            )
+            out = vlo + (vhi - vlo) * frac
+    # numpy layout: q dims lead the reduced shape
+    if not scalar_q:
+        out = jnp.moveaxis(out, axis, 0)
+    if keepdims:
+        out = jnp.expand_dims(out, axis + (0 if scalar_q else 1))
+    return out
+
+
 def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims=False) -> DNDarray:
-    """q-th percentile along axis (reference: statistics.py:1409)."""
+    """q-th percentile along axis (reference: statistics.py:1409 — a global
+    sort there).  When the reduction axis is the split axis, the distributed
+    merge-split sort (parallel/sort.py) orders the axis in place and only the
+    q-th slices are gathered, so the computation scales past one device's
+    memory."""
     sanitation.sanitize_in(x)
     axis_s = sanitize_axis(x.shape, axis)
     qv = q.larray if isinstance(q, DNDarray) else q
-    result = jnp.percentile(
-        x.larray.astype(jnp.float32) if not jnp.issubdtype(x.larray.dtype, jnp.inexact) else x.larray,
-        jnp.asarray(qv), axis=axis_s, method=interpolation, keepdims=keepdims,
-    )
+    if axis_s is None and x.ndim == 1:
+        axis_s = 0
+    if (
+        isinstance(axis_s, int)
+        and axis_s == x.split
+        and x.comm.size > 1
+        and x.is_distributed()
+        and interpolation in ("linear", "lower", "higher", "nearest", "midpoint")
+    ):
+        from .manipulations import sort as _sort
+
+        xf = x if jnp.issubdtype(x.larray.dtype, jnp.inexact) else x.astype(types.float32)
+        sv, _ = _sort(xf, axis=axis_s)
+        result = _percentile_of_sorted(
+            sv.larray, qv, axis_s, x.shape[axis_s], interpolation, keepdims
+        )
+    else:
+        result = jnp.percentile(
+            x.larray.astype(jnp.float32) if not jnp.issubdtype(x.larray.dtype, jnp.inexact) else x.larray,
+            jnp.asarray(qv), axis=axis_s, method=interpolation, keepdims=keepdims,
+        )
     wrapped = DNDarray(
         result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, x.device, x.comm
     )

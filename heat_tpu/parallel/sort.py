@@ -1,0 +1,169 @@
+"""Distributed sort along a split axis: block odd-even merge-split.
+
+The reference sorts a split axis with a hand-written sample sort — local
+sort, splitter exchange, ragged ``Alltoallv``, local merge
+(heat/core/manipulations.py:2261-3047).  Ragged exchanges don't exist on
+TPU: XLA collectives are static-shape.  The TPU-native redesign is a
+*block odd-even transposition sort*: every shard keeps a fixed-size block,
+each round partners exchange whole blocks over ICI (``ppermute``) and run a
+merge-split (left partner keeps the lower half, right the upper).  After
+``n_shards`` rounds the blocks are globally ordered — a classic result for
+merge-split networks (Knuth TAOCP 5.3.4) — with
+
+- static shapes end to end (the padded physical layout *is* the block),
+- peak per-device memory of two blocks (the global array never lands in
+  one place — the reference's reason for sample sort, kept),
+- only ``collective_permute`` on the wire: no all-gather of the data axis.
+
+Correctness detail: each merge orders by the **total** key
+``(pad, value, original index)``.  Totality is load-bearing, not a
+stylistic choice — the partners concatenate in opposite orders
+``(mine, theirs)``, so a mere ``(pad, value)`` key would let them disagree
+on tie order and the kept lower/upper halves could double-count one
+partner's duplicates while dropping the other's.  The index tiebreak makes
+both partners compute the same merged sequence, and as a bonus the sort is
+stable and its result independent of the mesh size.
+
+Pads sink to the global tail (their key class orders last), which is
+exactly the canonical physical layout of a split DNDarray, and NaNs keep
+NumPy's "sorted last among valid" position without sentinel arithmetic.
+
+``payloads`` ride along with the keys (1-D keys only): each merge round
+moves whole payload row-blocks with the same ``ppermute`` and reorders them
+with the same argsort — this is the sharded Fisher–Yates replacement
+(sort-by-random-key) behind ``randperm``/``permutation`` and the epoch
+shuffle (reference: random.py:649, utils/data/datatools.py:246).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .collectives import shard_map_unchecked
+
+__all__ = ["distributed_sort"]
+
+
+def _apply_order(order, arrs, axis):
+    """Gather every array by ``order`` along ``axis``; payloads with extra
+    trailing dims (1-D keys only) use a plain take on axis 0."""
+    key_ndim = order.ndim
+    out = []
+    for a in arrs:
+        if a.ndim == key_ndim:
+            out.append(jnp.take_along_axis(a, order, axis=axis))
+        else:
+            out.append(jnp.take(a, order, axis=0))
+    return out
+
+
+def _total_sort(arrs, axis, *, index_presorted=False):
+    """Stable-sort ``arrs = [vals, idxs, pad, *payloads]`` by the total key
+    ``(pad, value, index)`` via three stable argsort passes (least
+    significant first)."""
+    if not index_presorted:
+        order = jnp.argsort(arrs[1], axis=axis, stable=True)
+        arrs = _apply_order(order, arrs, axis)
+    order = jnp.argsort(arrs[0], axis=axis, stable=True)
+    arrs = _apply_order(order, arrs, axis)
+    order = jnp.argsort(arrs[2], axis=axis, stable=True)
+    return _apply_order(order, arrs, axis)
+
+
+def _build_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads=0):
+    """Build the shard_map'd odd-even merge-split sorter (jitted once per
+    (mesh, axis, shape-class) through the lru cache below)."""
+    nshards = mesh.shape[axis_name]
+    spec_list = [None] * ndim
+    spec_list[axis] = axis_name
+    key_spec = P(*spec_list)
+    payload_spec = P(axis_name)  # payloads: rows sharded on their axis 0
+
+    def local(phys_vals, *payloads):
+        r = lax.axis_index(axis_name)
+        shape = phys_vals.shape
+        axis_shape = tuple(per if d == axis else 1 for d in range(ndim))
+        # global position along the sort axis of each local element
+        pos = r * per + jnp.arange(per)
+        pad = jnp.broadcast_to((pos >= n_valid).reshape(axis_shape), shape)
+        idxs = jnp.broadcast_to(pos.reshape(axis_shape), shape).astype(jnp.int32)
+
+        arrs = _total_sort(
+            [phys_vals, idxs, pad, *payloads], axis, index_presorted=True
+        )
+
+        for round_ in range(nshards):
+            parity = round_ % 2
+            # partner pairs: even rounds (0,1)(2,3)…, odd rounds (1,2)(3,4)…
+            perm = []
+            for left in range(parity, nshards - 1, 2):
+                perm.append((left, left + 1))
+                perm.append((left + 1, left))
+            if not perm:
+                continue
+            others = [lax.ppermute(a, axis_name, perm) for a in arrs]
+            has_partner = jnp.zeros((), bool)
+            is_left = jnp.zeros((), bool)
+            for s, d in perm:
+                has_partner = has_partner | (r == s)
+                if s < d:
+                    is_left = is_left | (r == s)
+            merged = _total_sort(
+                [
+                    jnp.concatenate((a, o), axis=axis if a.ndim == ndim else 0)
+                    for a, o in zip(arrs, others)
+                ],
+                axis,
+            )
+            lo_hi = []
+            for m in merged:
+                ax = axis if m.ndim == ndim else 0
+                sel_lo = [slice(None)] * m.ndim
+                sel_hi = [slice(None)] * m.ndim
+                sel_lo[ax] = slice(0, per)
+                sel_hi[ax] = slice(per, 2 * per)
+                lo_hi.append(
+                    jnp.where(is_left, m[tuple(sel_lo)], m[tuple(sel_hi)])
+                )
+            arrs = [
+                jnp.where(has_partner, m, a) for m, a in zip(lo_hi, arrs)
+            ]
+        vals, idxs, _ = arrs[0], arrs[1], arrs[2]
+        return (vals, idxs, *arrs[3:])
+
+    in_specs = (key_spec,) + (payload_spec,) * n_payloads
+    out_specs = (key_spec, key_spec) + (payload_spec,) * n_payloads
+    return shard_map_unchecked(local, mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+@lru_cache(maxsize=None)
+def _jit_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads):
+    return jax.jit(_build_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads))
+
+
+def distributed_sort(
+    phys_vals: jax.Array, mesh, axis_name: str, axis: int, n_valid: int, payloads=()
+):
+    """Sort a physically even-sharded array along its split ``axis``.
+
+    ``phys_vals`` must carry the canonical even-chunk physical layout
+    (split dim a multiple of the mesh axis size; tail beyond ``n_valid``
+    is pad).  Returns ``(values, indices, *payloads)`` in the same physical
+    layout: logical elements globally ascending (stable on ties) with pads
+    at the global tail, ``indices`` the original global positions along
+    ``axis`` (int32), and every payload reordered by the same permutation
+    (payloads require 1-D keys and axis-0 sharded rows).
+    """
+    per = phys_vals.shape[axis] // mesh.shape[axis_name]
+    if payloads and phys_vals.ndim != 1:
+        raise ValueError("payloads require 1-D sort keys")
+    fn = _jit_sorter(
+        mesh, axis_name, axis, phys_vals.ndim, int(n_valid), per, len(payloads)
+    )
+    return fn(phys_vals, *payloads)

@@ -80,6 +80,12 @@ class Dataset:
         sets, like the reference's guard (datatools.py:231)."""
         if self.test_set:
             return
+        if all(a.split == 0 for a in self.arrays) and self.arrays:
+            # sharded epoch shuffle: rows ride the distributed sort as
+            # payloads — the reference's Alltoall (datatools.py:246)
+            # without ever replicating the permutation or the data
+            self.arrays = tuple(ht_random.shuffle_rows(list(self.arrays)))
+            return
         n = len(self)
         perm = ht_random.randperm(n).larray
         new = []

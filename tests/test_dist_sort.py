@@ -1,0 +1,281 @@
+"""Distributed sort (parallel/sort.py) and its consumers.
+
+The reference's sample sort (heat/core/manipulations.py:2261-3047) is
+redesigned as a block odd-even merge-split network.  These tests check the
+per-shard oracle (every shard's slab equals the corresponding NumPy slice)
+and that the compiled program moves data only with collective-permute —
+never an all-gather of the data axis, which is what caps the XLA global
+argsort at one device's memory.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+class TestDistributedSortOracle(TestCase):
+    def _check(self, A, axis=0, descending=False):
+        x = ht.array(A, split=axis)
+        v, i = ht.sort(x, axis=axis, descending=descending)
+        expect = np.sort(A, axis=axis)
+        if descending:
+            expect = np.flip(expect, axis=axis)
+        self.assert_array_equal(v, expect)
+        # indices reproduce the values
+        np.testing.assert_array_equal(
+            np.take_along_axis(A, i.numpy(), axis), v.numpy()
+        )
+        self.assertEqual(v.split, axis)
+
+    def test_1d_odd_length(self):
+        rng = np.random.default_rng(0)
+        self._check(rng.standard_normal(29).astype(np.float32))
+
+    def test_1d_descending(self):
+        rng = np.random.default_rng(1)
+        self._check(rng.standard_normal(21).astype(np.float32), descending=True)
+
+    def test_2d_split0(self):
+        rng = np.random.default_rng(2)
+        self._check(rng.standard_normal((13, 4)).astype(np.float32), axis=0)
+
+    def test_2d_split1(self):
+        rng = np.random.default_rng(3)
+        A = rng.standard_normal((5, 17)).astype(np.float32)
+        x = ht.array(A, split=1)
+        v, _ = ht.sort(x, axis=1)
+        self.assert_array_equal(v, np.sort(A, axis=1))
+
+    def test_duplicates_and_ints(self):
+        rng = np.random.default_rng(4)
+        self._check(rng.integers(0, 5, 23).astype(np.int32))
+
+    def test_nan_sorted_last(self):
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal(19).astype(np.float32)
+        A[2] = A[11] = np.nan
+        v, _ = ht.sort(ht.array(A, split=0))
+        np.testing.assert_allclose(v.numpy(), np.sort(A), rtol=1e-6)
+
+    def test_smaller_than_mesh(self):
+        # 3 elements over 8 devices: most shards all-pad
+        self._check(np.array([3.0, 1.0, 2.0], dtype=np.float32))
+
+    def test_sorted_input_is_stable_fixed_point(self):
+        A = np.arange(24, dtype=np.float32)
+        v, i = ht.sort(ht.array(A, split=0))
+        np.testing.assert_array_equal(v.numpy(), A)
+        np.testing.assert_array_equal(i.numpy(), np.arange(24))
+
+    def test_no_allgather_in_compiled_program(self):
+        """The sorter must ride collective-permute only: an all-gather of
+        the data axis would re-cap sorting at one device's memory."""
+        import jax
+        import numpy as np_
+
+        from heat_tpu.parallel.mesh import sanitize_comm
+        from heat_tpu.parallel.sort import _build_sorter
+
+        comm = sanitize_comm(None)
+        mesh = comm.mesh
+        per = 4
+        n = per * comm.size
+        fn = _build_sorter(mesh, comm.split_axis, 0, 1, n, per)
+        arr = jax.device_put(
+            np_.arange(n, dtype=np_.float32), comm.sharding(0, 1)
+        )
+        text = jax.jit(fn).lower(arr).compile().as_text()
+        self.assertIn("collective-permute", text)
+        self.assertNotIn("all-gather", text)
+        self.assertNotIn("all-to-all", text)
+
+
+class TestDistributedPercentile(TestCase):
+    def test_matches_numpy_all_methods(self):
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal(37).astype(np.float32)
+        x = ht.array(A, split=0)
+        for meth in ("linear", "lower", "higher", "nearest", "midpoint"):
+            got = ht.percentile(x, 37.0, interpolation=meth).numpy()
+            np.testing.assert_allclose(
+                got, np.percentile(A, 37.0, method=meth), rtol=1e-5,
+                err_msg=meth,
+            )
+
+    def test_vector_q_and_axis(self):
+        rng = np.random.default_rng(7)
+        B = rng.standard_normal((13, 4)).astype(np.float32)
+        got = ht.percentile(ht.array(B, split=0), [25.0, 75.0], axis=0)
+        np.testing.assert_allclose(
+            got.numpy(), np.percentile(B, [25.0, 75.0], axis=0), rtol=1e-5
+        )
+
+    def test_median_split_axis(self):
+        rng = np.random.default_rng(8)
+        A = rng.standard_normal(26).astype(np.float32)
+        np.testing.assert_allclose(
+            ht.median(ht.array(A, split=0)).numpy(), np.median(A), rtol=1e-5
+        )
+
+    def test_keepdims(self):
+        rng = np.random.default_rng(9)
+        B = rng.standard_normal((12, 3)).astype(np.float32)
+        got = ht.percentile(ht.array(B, split=0), 50.0, axis=0, keepdims=True)
+        np.testing.assert_allclose(
+            got.numpy(), np.percentile(B, 50.0, axis=0, keepdims=True),
+            rtol=1e-5,
+        )
+
+
+class TestDistributedUnique(TestCase):
+    def test_split_1d(self):
+        rng = np.random.default_rng(10)
+        D = rng.integers(0, 7, 31).astype(np.int32)
+        u = ht.unique(ht.array(D, split=0))
+        np.testing.assert_array_equal(u.numpy(), np.unique(D))
+
+    def test_return_inverse_reconstructs(self):
+        rng = np.random.default_rng(11)
+        D = rng.integers(-3, 3, 27).astype(np.int32)
+        u, inv = ht.unique(ht.array(D, split=0), return_inverse=True)
+        np.testing.assert_array_equal(u.numpy()[inv.numpy()], D)
+
+    def test_all_equal(self):
+        u = ht.unique(ht.array(np.full(20, 5.0, np.float32), split=0))
+        np.testing.assert_array_equal(u.numpy(), [5.0])
+
+    def test_all_distinct_floats(self):
+        rng = np.random.default_rng(12)
+        D = rng.standard_normal(22).astype(np.float32)
+        u = ht.unique(ht.array(D, split=0))
+        np.testing.assert_allclose(u.numpy(), np.unique(D), rtol=1e-6)
+
+
+class TestSortIndicesArePermutation(TestCase):
+    """Regression: the merge key must be total (pad, value, index).  With
+    only (pad, value), the two merge partners concat in opposite orders and
+    disagree on tie order, double-counting one side's duplicates while
+    dropping the other's — sorted *values* stay right, carried *indices*
+    silently stop being a permutation."""
+
+    def test_duplicates_yield_true_permutation(self):
+        D = np.array(
+            [5] * 10 + [1] * 6 + [2] * 7, dtype=np.float32
+        )
+        v, i = ht.sort(ht.array(D, split=0))
+        idx = i.numpy()
+        self.assertEqual(sorted(idx.tolist()), list(range(len(D))))
+        np.testing.assert_array_equal(v.numpy(), np.sort(D))
+
+    def test_stability_on_ties(self):
+        D = np.array([3, 1, 3, 1, 3, 1, 3, 1, 3, 1, 2] * 2, dtype=np.float32)
+        _, i = ht.sort(ht.array(D, split=0))
+        idx = i.numpy()
+        for k in range(len(D) - 1):
+            if D[idx[k]] == D[idx[k + 1]]:
+                self.assertLess(idx[k], idx[k + 1])
+
+    def test_result_mesh_size_invariant(self):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        rng = np.random.default_rng(13)
+        D = rng.integers(0, 4, 27).astype(np.float32)
+        _, i8 = ht.sort(ht.array(D, split=0))
+        _, i4 = ht.sort(ht.array(D, split=0, comm=local_mesh(4)))
+        np.testing.assert_array_equal(i8.numpy(), i4.numpy())
+
+
+class TestShardedPermutation(TestCase):
+    """randperm/permutation stay sharded (reference: the counter sequence
+    keeps them distributed, heat/core/random.py:55-201,649)."""
+
+    def test_randperm_split_is_permutation(self):
+        ht.random.seed(42)
+        p = ht.random.randperm(29, split=0)
+        self.assertEqual(p.split, 0)
+        self.assertEqual(sorted(p.numpy().tolist()), list(range(29)))
+
+    def test_randperm_mesh_size_invariant(self):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        ht.random.seed(42)
+        p8 = ht.random.randperm(29, split=0).numpy()
+        ht.random.seed(42)
+        p4 = ht.random.randperm(29, split=0, comm=local_mesh(4)).numpy()
+        np.testing.assert_array_equal(p8, p4)
+
+    def test_permutation_keeps_rows_intact(self):
+        X = np.arange(26 * 3, dtype=np.float32).reshape(26, 3)
+        ht.random.seed(7)
+        y = ht.random.permutation(ht.array(X, split=0))
+        yn = y.numpy()
+        self.assertEqual(y.split, 0)
+        self.assertFalse(np.array_equal(yn, X))
+        np.testing.assert_array_equal(np.sort(yn[:, 0]), X[:, 0])
+        np.testing.assert_array_equal(yn[:, 1] - yn[:, 0], np.ones(26))
+
+    def test_shuffle_rows_shared_permutation(self):
+        X = np.arange(26 * 3, dtype=np.float32).reshape(26, 3)
+        ht.random.seed(9)
+        a, b = ht.random.shuffle_rows(
+            [ht.array(X, split=0), ht.array(np.arange(26, dtype=np.float32), split=0)]
+        )
+        np.testing.assert_array_equal(a.numpy()[:, 0] / 3, b.numpy())
+
+    def test_shuffle_rows_no_allgather(self):
+        """The payload path must also stay on collective-permute."""
+        import jax
+
+        from heat_tpu.parallel.mesh import sanitize_comm
+        from heat_tpu.parallel.sort import _build_sorter
+
+        comm = sanitize_comm(None)
+        per = 2
+        n = per * comm.size
+        fn = _build_sorter(comm.mesh, comm.split_axis, 0, 1, n, per, n_payloads=1)
+        keys = jax.device_put(
+            np.arange(n, dtype=np.float32), comm.sharding(0, 1)
+        )
+        rows = jax.device_put(
+            np.zeros((n, 3), np.float32), comm.sharding(0, 2)
+        )
+        text = jax.jit(fn).lower(keys, rows).compile().as_text()
+        self.assertIn("collective-permute", text)
+        self.assertNotIn("all-gather", text)
+
+
+class TestPermutationKeysBijective(TestCase):
+    def test_feistel_keys_collision_free(self):
+        """Independent random keys collide (birthday) and every collision
+        falls back to the ascending-index tiebreak — a bias; the keyed
+        Feistel bijection of the index has no ties by construction."""
+        from heat_tpu.core.random import _perm_sort_keys
+
+        ht.random.seed(11)
+        k = _perm_sort_keys(50_000, None, None).numpy()
+        self.assertEqual(len(np.unique(k)), 50_000)
+
+
+class TestDescendingTieOrder(TestCase):
+    def test_descending_ties_match_single_device_stable(self):
+        """Descending must not be a flip of ascending — that reverses tie
+        order; it sorts a monotone-decreasing key transform instead."""
+        import jax.numpy as jnp
+
+        D = np.array([5.0, 5.0, 1.0, 5.0, 1.0] * 4, dtype=np.float32)
+        _, i = ht.sort(ht.array(D, split=0), descending=True)
+        expect = np.asarray(
+            jnp.argsort(jnp.asarray(D), descending=True, stable=True)
+        )
+        np.testing.assert_array_equal(i.numpy(), expect)
+
+    def test_descending_ints_min_value(self):
+        D = np.array([-2**31, 5, -7, 0, 2**31 - 1, 3, 3], dtype=np.int32)
+        v, _ = ht.sort(ht.array(D, split=0), descending=True)
+        np.testing.assert_array_equal(v.numpy(), np.sort(D)[::-1])
+
+    def test_descending_bool(self):
+        D = np.array([True, False, True, False, False, True, True, False, True])
+        v, _ = ht.sort(ht.array(D, split=0), descending=True)
+        np.testing.assert_array_equal(v.numpy(), np.sort(D)[::-1])
