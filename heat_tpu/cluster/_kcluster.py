@@ -189,13 +189,17 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             # uniform draw, indices never leave the device
             # uniforms stay float32: cast to a half-precision data dtype
             # would quantize the sampled indices to ~1.7k distinct rows
-            us = ht_random.rand(k).larray.astype(jnp.float32)
+            # scope the draw to x's communicator: a sub-mesh fit must not mix
+            # world-mesh arrays into the jitted init (comm.Split consumers)
+            us = ht_random.rand(k, comm=x.comm).larray.astype(jnp.float32)
             lo = jnp.arange(k) * (n // k)
             width = jnp.maximum(jnp.asarray(n // k), 1)
             idx = jnp.minimum(lo + (us * width).astype(jnp.int32), n - 1)
             centroids = arr[idx]
         elif isinstance(self.init, str) and self.init in ("probability_based", "kmeans++"):
-            us = ht_random.rand(k).larray.astype(jnp.float32)
+            # scope the draw to x's communicator: a sub-mesh fit must not mix
+            # world-mesh arrays into the jitted init (comm.Split consumers)
+            us = ht_random.rand(k, comm=x.comm).larray.astype(jnp.float32)
             centroids = _kmeanspp_init(arr, us, k)
         else:
             raise ValueError(

@@ -21,6 +21,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import optax
@@ -144,6 +145,24 @@ class DASO:
         self.batches_seen = 0
         self._last_losses = []
         self._sync_fn = None
+
+        # reference parity: the cross-node groups DASO builds with
+        # comm.Split (dp_optimizer.py:183-193) — here one sub-communicator
+        # per intra-slice position, spanning the DCN axis.  The sync path
+        # never uses them (XLA emits the DCN all-reduce from shardings);
+        # they exist for code that inspects the reference attribute.
+        self.reduced_comms: list = []
+        if self.dcn_axis is not None and len(self.axis_names) > 1:
+            ici_axis = self.axis_names[1]
+            ici_pos = self.axis_names.index(ici_axis)
+            devs = self.mesh.devices
+            from jax.sharding import Mesh as _Mesh
+
+            for i in range(int(self.mesh.shape[ici_axis])):
+                col = np.take(devs, [i], axis=ici_pos).reshape(-1)
+                self.reduced_comms.append(
+                    MeshComm(_Mesh(col, (self.dcn_axis,)), split_axis=self.dcn_axis)
+                )
 
     @property
     def n_slices(self) -> int:

@@ -188,15 +188,53 @@ class MeshComm(Communication):
         return NamedSharding(self.mesh, PartitionSpec())
 
     # --------------------------------------------------------------- factory
+    def _submesh(self, indices) -> "MeshComm":
+        """New MeshComm over the given positions along the split axis (other
+        mesh axes are preserved)."""
+        if not len(indices):
+            raise ValueError("sub-communicator needs at least one device")
+        axis_pos = self.mesh.axis_names.index(self.split_axis)
+        devices = np.take(self.mesh.devices, np.asarray(indices), axis=axis_pos)
+        return MeshComm(Mesh(devices, self.mesh.axis_names), split_axis=self.split_axis)
+
     def Split(self, color: int = 0, key: int = 0) -> "MeshComm":
         """Sub-communicator creation (reference: communication.py:470-481).
 
-        TPU meshes are static; a true sub-mesh requires constructing a new
-        ``Mesh`` over a device subset, which we expose via :func:`local_mesh`.
+        MPI semantics restated for a single controller: the split-axis
+        positions are partitioned into color groups, and the result is one
+        group's communicator over a sub-mesh of its devices.
+
+        * scalar ``color`` — the common MPI idiom where every member passes
+          the same value: returns a fresh communicator over all split-axis
+          devices.
+        * sequence ``color`` (one entry per split-axis position) — returns
+          the group containing position ``key``.  (MPI gives every rank its
+          own group; a single controller must name one — ``key`` doubles as
+          that perspective.  Use :meth:`split_groups` for all groups at
+          once; within a group, device order is preserved.)
         """
-        raise NotImplementedError(
-            "sub-communicators: build a new MeshComm over a device subset via local_mesh()"
-        )
+        colors = np.asarray(color)
+        if colors.ndim == 0:
+            return self._submesh(list(range(self.size)))
+        if colors.shape != (self.size,):
+            raise ValueError(
+                f"per-device colors must have shape ({self.size},), got {colors.shape}"
+            )
+        mine = colors[int(key) % self.size]
+        return self._submesh([i for i in range(self.size) if colors[i] == mine])
+
+    def split_groups(self, colors) -> dict:
+        """All color-group sub-communicators at once: ``{color: MeshComm}``
+        (the single-controller face of MPI's per-rank ``Split``)."""
+        colors = np.asarray(colors)
+        if colors.shape != (self.size,):
+            raise ValueError(
+                f"per-device colors must have shape ({self.size},), got {colors.shape}"
+            )
+        return {
+            c: self._submesh([i for i in range(self.size) if colors[i] == c])
+            for c in np.unique(colors).tolist()
+        }
 
 
 # ---------------------------------------------------------------------- world

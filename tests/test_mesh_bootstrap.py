@@ -6,6 +6,7 @@ a clean no-op.  ``hybrid_mesh`` is the two-tier NCCL-in-node/MPI-across
 topology of DASO (heat/optim/dp_optimizer.py:46) as mesh axes.
 """
 
+import heat_tpu as ht
 from .base import TestCase
 
 
@@ -102,3 +103,82 @@ class TestGraftEntryBootstrap(TestCase):
         ge = self._import_graft_entry()
         with self.assertRaises(RuntimeError):
             ge._bootstrap_devices(10**6)
+
+
+class TestMeshCommSplit(TestCase):
+    """Sub-communicators via sub-mesh construction (reference:
+    MPICommunication.Split, heat/core/communication.py:470-481)."""
+
+    def test_scalar_color_is_whole_mesh(self):
+        from heat_tpu.parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(None)
+        sub = comm.Split(0)
+        self.assertEqual(sub.size, comm.size)
+        self.assertIsNot(sub, comm)
+
+    def test_sequence_color_partitions(self):
+        from heat_tpu.parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(None)
+        colors = [i % 2 for i in range(comm.size)]
+        even = comm.Split(colors, key=0)
+        odd = comm.Split(colors, key=1)
+        self.assertEqual(even.size, (comm.size + 1) // 2)
+        self.assertEqual(odd.size, comm.size // 2)
+        even_devs = {d.id for d in even.mesh.devices.flat}
+        odd_devs = {d.id for d in odd.mesh.devices.flat}
+        self.assertFalse(even_devs & odd_devs)
+
+    def test_split_groups_covers_all_devices(self):
+        from heat_tpu.parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(None)
+        colors = [i % 3 for i in range(comm.size)]
+        groups = comm.split_groups(colors)
+        self.assertEqual(set(groups), set(colors))
+        total = sum(g.size for g in groups.values())
+        self.assertEqual(total, comm.size)
+
+    def test_bad_color_shape_rejected(self):
+        from heat_tpu.parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(None)
+        with self.assertRaises(ValueError):
+            comm.Split([0, 1])  # wrong length
+
+    def test_estimator_fit_on_submesh(self):
+        """Consumer: a sub-communicator scopes an estimator's collectives to
+        a device subset (the reference's reason for Split)."""
+        import numpy as np
+
+        from heat_tpu.parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(None)
+        half = comm.Split([0] * (comm.size // 2) + [1] * (comm.size - comm.size // 2), key=0)
+        rng = np.random.default_rng(0)
+        X = np.concatenate(
+            [rng.normal(-5, 0.3, (40, 2)), rng.normal(5, 0.3, (40, 2))]
+        ).astype(np.float32)
+        x = ht.array(X, split=0, comm=half)
+        self.assertEqual(x.comm.size, comm.size // 2)
+        km = ht.cluster.KMeans(n_clusters=2, init="kmeans++", max_iter=20)
+        km.fit(x)
+        centers = np.sort(np.asarray(km.cluster_centers_.numpy())[:, 0])
+        np.testing.assert_allclose(centers, [-5, 5], atol=0.5)
+
+    def test_daso_reduced_comms_parity(self):
+        import jax
+        import numpy as np
+        import optax
+        from jax.sharding import Mesh
+
+        from heat_tpu.optim import DASO, DataParallelOptimizer
+        from heat_tpu.parallel.mesh import MeshComm
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        comm = MeshComm(mesh, split_axis="ici")
+        daso = DASO(DataParallelOptimizer(optax.sgd(0.1)), mesh=mesh, comm=comm)
+        self.assertEqual(len(daso.reduced_comms), 4)
+        for rc in daso.reduced_comms:
+            self.assertEqual(rc.size, 2)  # spans the dcn axis
