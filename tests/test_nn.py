@@ -249,6 +249,81 @@ class TestDASOTwoTier(TestCase):
         self.assertFalse(np.allclose(w[0], w[1]))
 
 
+class TestDASOSyncSchedule(TestCase):
+    """VERDICT r1 #7: on a real (dcn=2, ici=4) mesh, parameters must agree
+    across slices exactly at scheduled global syncs and diverge between
+    them; plateau adaptation must widen the skip window; cooldown must
+    return to per-step sync (reference: dp_optimizer.py:336-730)."""
+
+    def _setup(self, warmup, cooldown, total):
+        import jax
+        import optax
+        from jax.sharding import Mesh
+        from heat_tpu.parallel.mesh import MeshComm
+
+        devices = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devices, ("dcn", "ici"))
+        comm = MeshComm(mesh, split_axis="ici")
+        daso = ht.optim.DASO(
+            ht.optim.DataParallelOptimizer(optax.sgd(0.1)),
+            mesh=mesh, comm=comm,
+            total_epochs=total, warmup_epochs=warmup, cooldown_epochs=cooldown,
+        )
+        model = ht.nn.DataParallelMultiGPU(
+            ht.models.MLP(features=(8, 2)), comm=comm, optimizer=daso
+        )
+        rng = np.random.default_rng(5)
+        X = rng.standard_normal((32, 4)).astype(np.float32)
+        y = rng.integers(0, 2, 32)
+        model.init(0, X[:4])
+        return daso, model, X, y
+
+    def _slices_agree(self, model):
+        import jax
+
+        w = np.asarray(jax.tree.leaves(model.params)[0])
+        return np.allclose(w[0], w[1], rtol=1e-6, atol=1e-7)
+
+    def test_params_change_only_at_scheduled_syncs(self):
+        daso, model, X, y = self._setup(warmup=0, cooldown=0, total=10)
+        daso.global_skip = 3
+        daso.batches_seen = 1  # step counter mid-stream, no step-0 sync
+        for step in range(2, 14):
+            was_sync = (step % 3) == 0  # batches_seen hits a multiple of 3
+            model.train_step(ht.array(X), ht.array(y))
+            self.assertEqual(daso.batches_seen, step)
+            self.assertEqual(
+                self._slices_agree(model), was_sync,
+                f"step {step}: agree={self._slices_agree(model)} expected sync={was_sync}",
+            )
+
+    def test_warmup_and_cooldown_sync_every_step(self):
+        daso, model, X, y = self._setup(warmup=2, cooldown=2, total=6)
+        self.assertEqual(daso.phase, "warmup")
+        daso.global_skip = 8  # must be ignored during warmup
+        for _ in range(3):
+            model.train_step(ht.array(X), ht.array(y))
+            self.assertTrue(self._slices_agree(model), "warmup must sync per step")
+        daso.epoch = 5  # jump to cooldown
+        self.assertEqual(daso.phase, "cooldown")
+        daso.global_skip = 8  # must be ignored during cooldown too
+        for _ in range(3):
+            model.train_step(ht.array(X), ht.array(y))
+            self.assertTrue(self._slices_agree(model), "cooldown must sync per step")
+
+    def test_plateau_widens_skip_worsening_narrows(self):
+        daso, model, X, y = self._setup(warmup=0, cooldown=0, total=20)
+        daso.epoch = 1  # cycling
+        daso.global_skip = 2
+        daso._last_losses = [1.0]
+        daso.epoch_loss_logic(0.999)  # plateau: relative improvement < 5%
+        self.assertEqual(daso.global_skip, 4)
+        daso.epoch_loss_logic(0.998)  # still plateaued (tiny improvement)
+        self.assertEqual(daso.global_skip, 8)
+        daso.epoch_loss_logic(1.5)  # worsening → halve
+        self.assertEqual(daso.global_skip, 4)
+
+
 class TestNNReviewRegressions(TestCase):
     """Regressions for the NN-layer review findings."""
 
