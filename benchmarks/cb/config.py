@@ -2,10 +2,30 @@
 # reference CI sizes on CPU (mpirun -n 4 equivalents), larger on TPU where
 # the MXU would otherwise be idle.
 import jax
+import numpy as np
 
 ON_TPU = jax.default_backend() == "tpu"
 
+
+@jax.jit
+def _first_scalar(a):
+    return a.ravel()[0] if a.ndim else a
+
+
+def drain(x) -> float:
+    """Read one scalar of ``x`` back to the host, forcing the whole
+    computation it depends on.  block_until_ready alone does not
+    synchronize through remote TPU tunnels (bench.py), so every monitored
+    workload ends with this — and every warmup call runs it too, so the
+    tiny readback program is compiled before the timed region."""
+    return float(np.asarray(_first_scalar(x)))
+
 MATMUL_N = 8192 if ON_TPU else 1500
+# short kernels chain several iterations inside the monitored region so the
+# measured span dwarfs the remote-tunnel round trip (bench.py's recipe)
+MATMUL_ITERS = 20 if ON_TPU else 2
+ATTN_ITERS = 10 if ON_TPU else 2
+MOE_ITERS = 10 if ON_TPU else 2
 QR_N = 2048 if ON_TPU else 512
 TSQR_M, TSQR_N = (1_000_000, 128) if ON_TPU else (20_000, 64)
 CLUSTER_N = 250_000 if ON_TPU else 5_000
@@ -13,3 +33,8 @@ RESHAPE_SIZES = [10_000, 20_000, 40_000] if ON_TPU else [1_000, 2_000]
 CONCAT_N = 1_000_000 if ON_TPU else 50_000
 ATTN_BH, ATTN_S, ATTN_D = (16, 4096, 128) if ON_TPU else (4, 256, 32)
 MOE_T, MOE_D, MOE_H = (16_384, 1024, 4096) if ON_TPU else (512, 64, 128)
+# 5e5x1e3 f32: the fit holds x, its unit-norm copy and intermediates — ~8 GB
+# peak of a 16 GB v5e; 1e6 rows would OOM during the normalization
+LASSO_M, LASSO_N = (500_000, 1_000) if ON_TPU else (2_000, 32)
+LASSO_ITERS = 10
+RESNET_BATCH, RESNET_IMG, RESNET_STEPS = (64, 224, 4) if ON_TPU else (8, 32, 2)

@@ -1,54 +1,94 @@
 # Continuous-benchmark linalg workloads (reference: benchmarks/cb/linalg.py:
 # matmul n=3000 split 0/1, qr n=2000 tiles 1-2 split 0/1, lanczos n=50 f64).
+#
+# Data is generated in run() and every kernel is warmed (compiled) before
+# the monitored call, so the monitored region times the kernel — not host
+# RNG, transfer, or XLA compilation.
+
 import heat_tpu as ht
 from heat_tpu.utils.monitor import monitor
 
 import config
 
 
-@monitor()
-def matmul_split_0(n: int = config.MATMUL_N):
-    a = ht.random.random((n, n), split=0)
-    b = ht.random.random((n, n), split=0)
-    return (a @ b).larray
+def _mm(a, b):
+    # chained square matmuls: one dependent chain, so the final readback
+    # (monitor's drain) forces every link; values may overflow — the
+    # timing is unaffected and derive() divides by the chain length
+    c = a
+    for _ in range(config.MATMUL_ITERS):
+        c = c @ b
+    return c.larray
 
 
-@monitor()
-def matmul_split_1(n: int = config.MATMUL_N):
-    a = ht.random.random((n, n), split=1)
-    b = ht.random.random((n, n), split=1)
-    return (a @ b).larray
+def _qr_q(a):
+    return ht.linalg.qr(a).Q.larray
 
 
-@monitor()
-def qr(n: int = config.QR_N):
-    outs = []
-    for sp in range(2):
-        a = ht.random.random((n, n), split=sp)
-        outs.append(ht.linalg.qr(a).Q.larray)
-    return outs
-
-
-@monitor()
-def tsqr_tall_skinny(m: int = config.TSQR_M, n: int = config.TSQR_N):
-    a = ht.random.random((m, n), split=0)
+def _tsqr_r(a):
     return ht.linalg.qr(a).R.larray
 
 
-@monitor()
-def lanczos(n: int = 50):
-    A = ht.random.random((n, n), dtype=ht.float64, split=0)
-    B = A @ A.T
-    V, T = ht.lanczos(B, m=n)
+def _lanczos(B, m):
+    V, T = ht.lanczos(B, m=m)
     return V.larray
 
 
+@monitor()
+def matmul_split_0(a, b):
+    return config.drain(_mm(a, b))
+
+
+@monitor()
+def matmul_split_1(a, b):
+    return config.drain(_mm(a, b))
+
+
+@monitor()
+def qr(mats):
+    return [config.drain(_qr_q(a)) for a in mats]
+
+
+@monitor()
+def tsqr_tall_skinny(a):
+    return config.drain(_tsqr_r(a))
+
+
+@monitor()
+def lanczos(B, m):
+    return config.drain(_lanczos(B, m))
+
+
 def run():
-    matmul_split_0()
-    matmul_split_1()
-    qr()
-    tsqr_tall_skinny()
-    lanczos()
+    n = config.MATMUL_N
+    a0 = ht.random.random((n, n), split=0)
+    b0 = ht.random.random((n, n), split=0)
+    config.drain(_mm(a0, b0))  # warmup: compile (incl. the drain readback)
+    matmul_split_0(a0, b0)
+
+    a1 = ht.random.random((n, n), split=1)
+    b1 = ht.random.random((n, n), split=1)
+    config.drain(_mm(a1, b1))
+    matmul_split_1(a1, b1)
+    del a0, b0, a1, b1
+
+    qn = config.QR_N
+    mats = [ht.random.random((qn, qn), split=sp) for sp in range(2)]
+    for m_ in mats:
+        config.drain(_qr_q(m_))
+    qr(mats)
+    del mats
+
+    ts = ht.random.random((config.TSQR_M, config.TSQR_N), split=0)
+    config.drain(_tsqr_r(ts))
+    tsqr_tall_skinny(ts)
+    del ts
+
+    ln = 50
+    A = ht.random.random((ln, ln), dtype=ht.float64, split=0)
+    B = A @ A.T
+    config.drain(_lanczos(B, ln))
+    lanczos(B, ln)
 
 
 if __name__ == "__main__":

@@ -1,20 +1,98 @@
 # Continuous-benchmark entry (reference: benchmarks/cb/main.py, run by CI as
-# `mpirun -n 4 python benchmarks/cb/main.py` under perun).  Here: one process
-# driving the whole mesh; each workload prints a JSON measurement line.
+# `mpirun -n 4 python benchmarks/cb/main.py` under perun;
+# .github/workflows/benchmark_main.yml:25).  Here: one process driving the
+# whole mesh; each workload prints a JSON measurement line, and
+# `--out FILE` writes the whole suite (raw measurements + derived
+# north-star metrics) as one JSON document for the round's record.
+import argparse
 import json
 import sys
 
-import linalg
 import cluster
+import config
+import linalg
 import manipulations
 import nn
+import regression
 
 from heat_tpu.utils import monitor as _monitor
 
+
+def derive(measurements):
+    """North-star metrics (BASELINE.md) computed from config + wall time."""
+    by = {m["name"]: m for m in measurements}
+    out = {}
+    if "matmul_split_0" in by:
+        n, t = config.MATMUL_N, by["matmul_split_0"]["wall_s"]
+        out["matmul_tflops"] = round(config.MATMUL_ITERS * 2 * n**3 / t / 1e12, 3)
+    if "tsqr_tall_skinny" in by:
+        m, n = config.TSQR_M, config.TSQR_N
+        t = by["tsqr_tall_skinny"]["wall_s"]
+        # tall-skinny QR ~ 2mn^2 flops
+        out["tsqr_gflops"] = round(2 * m * n * n / t / 1e9, 3)
+    if "kmeans" in by:
+        t = by["kmeans"]["wall_s"]
+        # the spherical dataset holds 4 * CLUSTER_N samples (4 clusters)
+        out["kmeans_samples_per_s"] = round(4 * config.CLUSTER_N / t, 1)
+    if "lasso_fit" in by:
+        t = by["lasso_fit"]["wall_s"]
+        out["lasso_rows_per_s"] = round(config.LASSO_M * config.LASSO_ITERS / t, 1)
+    if "resnet50_dp_steps" in by:
+        t = by["resnet50_dp_steps"]["wall_s"]
+        imgs = config.RESNET_BATCH * config.RESNET_STEPS
+        out["resnet50_img_per_s"] = round(imgs / t, 2)
+        if config.RESNET_IMG == 224:
+            # fwd ~4.09 GFLOP/img at 224^2; fwd+bwd ~3x
+            out["resnet50_tflops"] = round(imgs * 3 * 4.09e9 / t / 1e12, 3)
+    if "flash_attention_forward" in by:
+        bh, s, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
+        t = by["flash_attention_forward"]["wall_s"]
+        # causal attention ~ 2 * (qk + pv) * 0.5 = 2*bh*s^2*d
+        out["attention_tflops"] = round(config.ATTN_ITERS * 2 * bh * s * s * d / t / 1e12, 3)
+    if "moe_ffn_forward" in by:
+        tkn, dm, h = config.MOE_T, config.MOE_D, config.MOE_H
+        t = by["moe_ffn_forward"]["wall_s"]
+        # top-2 routing: 2 experts/token, in+out projections
+        out["moe_tflops"] = round(config.MOE_ITERS * 2 * 2 * tkn * 2 * dm * h / t / 1e12, 3)
+    return out
+
+
 if __name__ == "__main__":
-    linalg.run()
-    cluster.run()
-    manipulations.run()
-    nn.run()
-    print(json.dumps({"suite": "cb", "measurements": _monitor.measurements()}))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="write suite JSON to this path")
+    ap.add_argument(
+        "--only",
+        default=None,
+        help="comma-separated subset: linalg,cluster,manipulations,nn,regression",
+    )
+    args = ap.parse_args()
+
+    suites = {
+        "linalg": linalg.run,
+        "cluster": cluster.run,
+        "manipulations": manipulations.run,
+        "nn": nn.run,
+        "regression": regression.run,
+    }
+    selected = (
+        [s.strip() for s in args.only.split(",") if s.strip()]
+        if args.only
+        else list(suites)
+    )
+    unknown = [s for s in selected if s not in suites]
+    if unknown:
+        ap.error(f"unknown suite(s) {unknown}; valid: {sorted(suites)}")
+    for name in selected:
+        suites[name]()
+
+    doc = {
+        "suite": "cb",
+        "backend": "tpu" if config.ON_TPU else "cpu",
+        "measurements": _monitor.measurements(),
+        "derived": derive(_monitor.measurements()),
+    }
+    print(json.dumps(doc))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(doc, fh, indent=1)
     sys.exit(0)

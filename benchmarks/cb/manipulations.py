@@ -1,38 +1,45 @@
 # Continuous-benchmark manipulation workloads (reference: benchmarks/cb/
 # manipulations.py: reshape with new_split; plus the concatenate/resplit
 # cases from the CI suite, SURVEY.md §6).
+
 import heat_tpu as ht
 from heat_tpu.utils.monitor import monitor
 
 import config
 
 
-@monitor()
-def reshape(sizes=config.RESHAPE_SIZES):
+def _reshape(sizes):
     outs = []
     for size in sizes:
         st = ht.zeros((1000, size), split=1)
         outs.append(ht.reshape(st, (st.size // 10, -1), new_split=1).larray)
-    return outs
+    return [config.drain(o) for o in outs]
 
 
 @monitor()
-def concatenate(n: int = config.CONCAT_N):
-    a = ht.random.random((n, 64), split=0)
-    b = ht.random.random((n, 64), split=0)
-    return ht.concatenate([a, b], axis=0).larray
+def reshape(sizes=config.RESHAPE_SIZES):
+    return _reshape(sizes)
 
 
 @monitor()
-def resplit(n: int = config.CONCAT_N):
-    a = ht.random.random((n, 64), split=0)
-    return ht.resplit(a, 1).larray
+def concatenate(a, b):
+    return config.drain(ht.concatenate([a, b], axis=0).larray)
+
+
+@monitor()
+def resplit(a):
+    return config.drain(ht.resplit(a, 1).larray)
 
 
 def run():
+    _reshape(config.RESHAPE_SIZES)  # warmup
     reshape()
-    concatenate()
-    resplit()
+    a = ht.random.random((config.CONCAT_N, 64), split=0)
+    b = ht.random.random((config.CONCAT_N, 64), split=0)
+    config.drain(ht.concatenate([a, b], axis=0).larray)
+    concatenate(a, b)
+    config.drain(ht.resplit(a, 1).larray)
+    resplit(a)
 
 
 if __name__ == "__main__":

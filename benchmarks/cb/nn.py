@@ -18,25 +18,60 @@ import config
 def _attention_step(q):
     from heat_tpu.ops.attention import flash_attention
 
-    return flash_attention(q, q, q, causal=True)
+    out = q
+    for _ in range(config.ATTN_ITERS):
+        out = flash_attention(out, out, out, causal=True)
+    return out
 
 
 @jax.jit
 def _moe_step(x, gate, w_in, w_out):
     from heat_tpu.parallel.expert import moe_ffn
 
-    y, _ = moe_ffn(x, gate, w_in, w_out, k=2)
+    y = x
+    for _ in range(config.MOE_ITERS):
+        y, _ = moe_ffn(y, gate, w_in, w_out, k=2)
     return y
 
 
 @monitor()
 def flash_attention_forward(q):
-    return jax.block_until_ready(_attention_step(q))
+    return config.drain(_attention_step(q))
 
 
 @monitor()
 def moe_ffn_forward(x, gate, w_in, w_out):
-    return jax.block_until_ready(_moe_step(x, gate, w_in, w_out))
+    return config.drain(_moe_step(x, gate, w_in, w_out))
+
+
+@monitor()
+def resnet50_dp_steps(model, X, y, steps):
+    loss = None
+    for _ in range(steps):
+        loss = model.train_step(X, y)
+    return config.drain(loss)
+
+
+def _resnet_bench():
+    # the BASELINE.md DP flagship: ResNet-50 train step, batch sharded over
+    # the mesh, grad all-reduce implicit in the jitted step
+    import optax
+
+    import heat_tpu as ht
+
+    rng = np.random.default_rng(1)
+    b, img = config.RESNET_BATCH, config.RESNET_IMG
+    Xh = rng.standard_normal((b, img, img, 3)).astype(np.float32)
+    yh = rng.integers(0, 1000, b)
+    model = ht.nn.DataParallel(
+        ht.models.ResNet50(num_classes=1000),
+        optimizer=ht.optim.DataParallelOptimizer(optax.sgd(0.1)),
+    )
+    model.init(0, Xh[: min(b, 8)])
+    X = ht.array(Xh, split=0)
+    y = ht.array(yh, split=0)
+    config.drain(model.train_step(X, y))  # warmup: compile (incl. drain)
+    resnet50_dp_steps(model, X, y, config.RESNET_STEPS)
 
 
 def run():
@@ -45,7 +80,7 @@ def run():
 
     bh, s, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
     q = jnp.asarray(rng.standard_normal((bh, s, d)), dt)
-    jax.block_until_ready(_attention_step(q))  # warmup: compile
+    config.drain(_attention_step(q))  # warmup: compile
     flash_attention_forward(q)
 
     t, dm, h = config.MOE_T, config.MOE_D, config.MOE_H
@@ -53,8 +88,10 @@ def run():
     gate = jnp.asarray(rng.standard_normal((dm, 8)), dt)
     w_in = jnp.asarray(rng.standard_normal((8, dm, h)) / 32, dt)
     w_out = jnp.asarray(rng.standard_normal((8, h, dm)) / 32, dt)
-    jax.block_until_ready(_moe_step(x, gate, w_in, w_out))  # warmup: compile
+    config.drain(_moe_step(x, gate, w_in, w_out))  # warmup: compile
     moe_ffn_forward(x, gate, w_in, w_out)
+
+    _resnet_bench()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,36 @@
+# Continuous-benchmark regression workload (reference: benchmarks/2020/lasso
+# configs; BASELINE.md's Lasso row: synthetic design matrix, split=0).
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.utils.monitor import monitor
+
+import config
+
+
+def _fit(x, y):
+    est = ht.regression.Lasso(lam=0.01, max_iter=config.LASSO_ITERS)
+    est.fit(x, y)
+    return config.drain(est.coef_.larray)
+
+
+@monitor()
+def lasso_fit(x, y):
+    return _fit(x, y)
+
+
+def run():
+    m, n = config.LASSO_M, config.LASSO_N
+    x = ht.random.randn(m, n, split=0)
+    # unit-norm features (the coordinate-descent update's assumption)
+    norm = ht.sqrt(ht.mean(x * x, axis=0)) + 1e-12
+    x = x / ht.reshape(norm, (1, -1))
+    beta = np.zeros((n, 1), np.float32)
+    beta[:: max(n // 16, 1)] = 2.0
+    y = ht.matmul(x, ht.array(beta)) + 0.01 * ht.random.randn(m, 1, split=0)
+    _fit(x, y)  # warmup: compile the coordinate-descent loop
+    lasso_fit(x, y)
+
+
+if __name__ == "__main__":
+    run()

@@ -51,7 +51,11 @@ def monitor(name: Optional[str] = None, emit: bool = True) -> Callable:
             mem0 = _device_memory()
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
-            # drain async dispatch so the clock covers the device work
+            # drain async dispatch so the clock covers the device work.
+            # NOTE: through a remote TPU tunnel this does not fully
+            # synchronize (see bench.py) — workloads that need exact
+            # timing there should end with a warmed scalar readback
+            # (benchmarks/cb/config.py:drain).
             try:
                 jax.block_until_ready(out)
             except Exception:
