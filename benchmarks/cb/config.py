@@ -20,6 +20,22 @@ def drain(x) -> float:
     tiny readback program is compiled before the timed region."""
     return float(np.asarray(_first_scalar(x)))
 
+
+@jax.jit
+def _first_scalar_sum(xs):
+    import jax.numpy as jnp
+
+    return sum(
+        (x.ravel()[0] if x.ndim else x).astype(jnp.float32) for x in xs
+    )
+
+
+def drain_all(*xs) -> float:
+    """One readback covering several arrays: a timed region must not hold
+    multiple sequential drains (each is a full tunnel round trip that
+    serializes dispatch)."""
+    return float(np.asarray(_first_scalar_sum(list(xs))))
+
 MATMUL_N = 8192 if ON_TPU else 1500
 # short kernels chain several iterations inside the monitored region so the
 # measured span dwarfs the remote-tunnel round trip (bench.py's recipe)
@@ -37,4 +53,4 @@ MOE_T, MOE_D, MOE_H = (16_384, 1024, 4096) if ON_TPU else (512, 64, 128)
 # peak of a 16 GB v5e; 1e6 rows would OOM during the normalization
 LASSO_M, LASSO_N = (500_000, 1_000) if ON_TPU else (2_000, 32)
 LASSO_ITERS = 10
-RESNET_BATCH, RESNET_IMG, RESNET_STEPS = (64, 224, 4) if ON_TPU else (8, 32, 2)
+RESNET_BATCH, RESNET_IMG, RESNET_STEPS = (256, 224, 4) if ON_TPU else (8, 32, 2)

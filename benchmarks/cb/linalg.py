@@ -46,7 +46,7 @@ def matmul_split_1(a, b):
 
 @monitor()
 def qr(mats):
-    return [config.drain(_qr_q(a)) for a in mats]
+    return config.drain_all(*[_qr_q(a) for a in mats])
 
 
 @monitor()
@@ -74,8 +74,7 @@ def run():
 
     qn = config.QR_N
     mats = [ht.random.random((qn, qn), split=sp) for sp in range(2)]
-    for m_ in mats:
-        config.drain(_qr_q(m_))
+    config.drain_all(*[_qr_q(m_) for m_ in mats])  # warmup
     qr(mats)
     del mats
 

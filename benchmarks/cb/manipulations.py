@@ -13,7 +13,7 @@ def _reshape(sizes):
     for size in sizes:
         st = ht.zeros((1000, size), split=1)
         outs.append(ht.reshape(st, (st.size // 10, -1), new_split=1).larray)
-    return [config.drain(o) for o in outs]
+    return config.drain_all(*outs)
 
 
 @monitor()

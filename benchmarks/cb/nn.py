@@ -61,10 +61,11 @@ def _resnet_bench():
 
     rng = np.random.default_rng(1)
     b, img = config.RESNET_BATCH, config.RESNET_IMG
-    Xh = rng.standard_normal((b, img, img, 3)).astype(np.float32)
+    dt = jnp.bfloat16 if config.ON_TPU else jnp.float32
+    Xh = rng.standard_normal((b, img, img, 3)).astype(np.float32).astype(dt)
     yh = rng.integers(0, 1000, b)
     model = ht.nn.DataParallel(
-        ht.models.ResNet50(num_classes=1000),
+        ht.models.ResNet50(num_classes=1000, dtype=dt),
         optimizer=ht.optim.DataParallelOptimizer(optax.sgd(0.1)),
     )
     model.init(0, Xh[: min(b, 8)])

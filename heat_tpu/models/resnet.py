@@ -89,7 +89,12 @@ class ResNet(nn.Module):
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,  # bn statistics stay f32 even under bf16 compute
+            # follow the compute dtype: flax computes the mean/var in f32
+            # internally and keeps running stats f32 regardless, but a
+            # f32 `dtype` here would cast every activation map to f32 —
+            # the training step is HBM-bound, and those casts alone cost
+            # ~20% of the step (profiled on v5e, bf16 batch 128)
+            dtype=self.dtype,
         )
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
