@@ -1,0 +1,104 @@
+"""Estimator convergence vs scikit-learn oracles (reference: the estimator
+test dirs validate fits against known structure; here the sklearn
+implementations provide an independent numerical oracle)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+def _blobs(n_per, centers, scale, seed):
+    rng = np.random.default_rng(seed)
+    X = np.concatenate(
+        [rng.normal(c, scale, (n_per, len(c))) for c in centers]
+    ).astype(np.float32)
+    y = np.repeat(np.arange(len(centers)), n_per)
+    perm = rng.permutation(len(X))
+    return X[perm], y[perm]
+
+
+class TestKMeansVsSklearn(TestCase):
+    def test_centers_match(self):
+        from sklearn.cluster import KMeans as SKKMeans
+
+        X, _ = _blobs(120, [(-5, -5), (5, 5), (-5, 5)], 0.4, 0)
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", max_iter=50, random_state=0)
+        km.fit(ht.array(X, split=0))
+        ours = np.sort(np.asarray(km.cluster_centers_.numpy()), axis=0)
+        sk = SKKMeans(n_clusters=3, n_init=5, random_state=0).fit(X)
+        theirs = np.sort(sk.cluster_centers_, axis=0)
+        np.testing.assert_allclose(ours, theirs, atol=0.3)
+
+    def test_inertia_comparable(self):
+        from sklearn.cluster import KMeans as SKKMeans
+
+        X, _ = _blobs(100, [(-4, 0), (4, 0)], 0.5, 1)
+        km = ht.cluster.KMeans(n_clusters=2, init="kmeans++", max_iter=50, random_state=1)
+        km.fit(ht.array(X, split=0))
+        sk = SKKMeans(n_clusters=2, n_init=5, random_state=0).fit(X)
+        d = ht.spatial.cdist(ht.array(X, split=0), km.cluster_centers_).numpy()
+        ours_inertia = (d.min(axis=1) ** 2).sum()
+        self.assertLess(ours_inertia, sk.inertia_ * 1.1 + 1e-6)
+
+
+class TestGaussianNBVsSklearn(TestCase):
+    def test_predictions_match(self):
+        from sklearn.naive_bayes import GaussianNB as SKGNB
+
+        X, y = _blobs(80, [(-3, -3), (3, 3), (3, -3)], 1.0, 2)
+        ours = ht.naive_bayes.GaussianNB()
+        ours.fit(ht.array(X, split=0), ht.array(y, split=0))
+        pred = np.asarray(ours.predict(ht.array(X, split=0)).numpy()).reshape(-1)
+        sk_pred = SKGNB().fit(X, y).predict(X)
+        agree = (pred == sk_pred).mean()
+        self.assertGreater(agree, 0.98)
+
+
+class TestKNNVsSklearn(TestCase):
+    def test_predictions_match(self):
+        from sklearn.neighbors import KNeighborsClassifier as SKKNN
+
+        X, y = _blobs(60, [(-3, 0), (3, 0)], 0.8, 3)
+        Xt, yt = _blobs(20, [(-3, 0), (3, 0)], 0.8, 4)
+        ours = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        ours.fit(ht.array(X, split=0), ht.array(y, split=0))
+        pred = np.asarray(ours.predict(ht.array(Xt, split=0)).numpy()).reshape(-1)
+        sk_pred = SKKNN(n_neighbors=5).fit(X, y).predict(Xt)
+        agree = (pred == sk_pred).mean()
+        self.assertGreater(agree, 0.95)
+
+
+class TestLassoVsSklearn(TestCase):
+    def test_coefficients_match(self):
+        from sklearn.linear_model import Lasso as SKLasso
+
+        rng = np.random.default_rng(5)
+        n, f = 400, 12
+        X = rng.standard_normal((n, f)).astype(np.float32)
+        X = X / np.sqrt((X**2).mean(axis=0, keepdims=True))
+        beta = np.zeros(f, np.float32)
+        beta[[2, 7]] = [3.0, -2.0]
+        yv = X @ beta + 0.01 * rng.standard_normal(n).astype(np.float32)
+
+        lam = 0.01
+        ours = ht.regression.Lasso(lam=lam, max_iter=500, tol=1e-8)
+        ours.fit(ht.array(X, split=0), ht.array(yv.reshape(-1, 1), split=0))
+        coef = ours.coef_.numpy().reshape(-1)
+        # sklearn's objective: 1/(2n)||y-Xw||^2 + alpha*||w||_1 with
+        # intercept; our lam plays the same role under unit-RMS features
+        sk = SKLasso(alpha=lam / 2, max_iter=5000).fit(X, yv)
+        np.testing.assert_allclose(coef, sk.coef_, atol=0.05)
+
+
+class TestSpectralClusteringStructure(TestCase):
+    def test_two_moons_separation(self):
+        # two well-separated blobs: spectral must match ground truth up to
+        # label permutation
+        X, y = _blobs(40, [(-6, 0), (6, 0)], 0.4, 6)
+        # gamma small enough that the similarity graph stays connected
+        # (disconnected blocks make the Lanczos eigenproblem degenerate)
+        sp = ht.cluster.Spectral(n_clusters=2, gamma=0.1, n_lanczos=30)
+        labels = np.asarray(sp.fit_predict(ht.array(X, split=0)).numpy()).reshape(-1)
+        same = (labels == y).mean()
+        self.assertGreater(max(same, 1 - same), 0.95)
