@@ -32,10 +32,10 @@ def run():
     _fit(x, y)  # warmup: compile the coordinate-descent loop
     est = lasso_fit(x, y)
     # the loop early-exits on tol: record the sweeps that actually ran so
-    # derive() credits real work (reviewed: rows/s was inflated otherwise)
-    from heat_tpu.utils import monitor as _mon
+    # derive() credits real work (rows/s was inflated otherwise)
+    from heat_tpu.utils.monitor import annotate_last
 
-    _mon.measurements()[-1]["n_iter"] = int(est.n_iter)
+    annotate_last(n_iter=int(est.n_iter))
 
 
 if __name__ == "__main__":

@@ -82,6 +82,14 @@ def measurements() -> List[Dict[str, Any]]:
     return list(_MEASUREMENTS)
 
 
+def annotate_last(**fields) -> None:
+    """Attach extra fields to the most recent measurement (e.g. the
+    iteration count a workload actually ran, for honest derived rates)."""
+    if not _MEASUREMENTS:
+        raise RuntimeError("no measurement to annotate")
+    _MEASUREMENTS[-1].update(fields)
+
+
 def report(file=None) -> None:
     """Write every measurement as one JSON line (default: stderr)."""
     out = file or sys.stderr
