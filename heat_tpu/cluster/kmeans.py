@@ -24,14 +24,14 @@ from ._kcluster import _KCluster
 __all__ = ["KMeans"]
 
 
-@partial(jax.jit, static_argnames=("k",))
-def _lloyd_loop(x, centers, k: int, max_iter, tol):
-    """Run Lloyd iterations until ``shift² <= tol`` or ``max_iter``, entirely
-    on-device (``lax.while_loop``).  The reference reads the convergence
-    scalar back to the host every iteration (kmeans.py:102-139, ``.item()``
-    broadcast); through a remote TPU tunnel one readback costs ~100× an
-    iteration's compute, so the whole loop is a single XLA program and the
-    host sees only the final (centers, shift, inertia, n_iter)."""
+def _lloyd_while(step, centers, max_iter, tol):
+    """Shared convergence driver: iterate ``step`` until ``shift² <= tol``
+    or ``max_iter``, entirely on-device (``lax.while_loop``).  The
+    reference reads the convergence scalar back to the host every iteration
+    (kmeans.py:102-139, ``.item()`` broadcast); through a remote TPU tunnel
+    one readback costs ~100× an iteration's compute, so the whole loop is a
+    single XLA program and the host sees only the final
+    (centers, shift, inertia, n_iter)."""
 
     def cond(state):
         _, shift, _, it = state
@@ -39,7 +39,7 @@ def _lloyd_loop(x, centers, k: int, max_iter, tol):
 
     def body(state):
         centers, _, _, it = state
-        new_centers, shift, inertia = _lloyd_step(x, centers, k)
+        new_centers, shift, inertia = step(centers)
         return new_centers, shift, inertia, it + 1
 
     # convergence scalars stay f32 whatever the data dtype: shift/inertia
@@ -47,6 +47,14 @@ def _lloyd_loop(x, centers, k: int, max_iter, tol):
     # mismatch the while_loop types and quantize the tol comparison
     init = (centers, jnp.array(jnp.inf, jnp.float32), jnp.array(0.0, jnp.float32), 0)
     return jax.lax.while_loop(cond, body, init)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _lloyd_loop(x, centers, k: int, max_iter, tol):
+    """Lloyd iterations over unpacked data (see :func:`_lloyd_while`)."""
+    return _lloyd_while(
+        lambda c: _lloyd_step(x, c, k), centers, max_iter, tol
+    )
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -74,6 +82,138 @@ def _lloyd_step(x, centers, k: int):
     # take_along_axis gather here costs ~20x the rest of the step on TPU
     inertia = jnp.sum(jnp.min(d2, axis=1))
     return new_centers, shift, inertia
+
+
+@partial(jax.jit, static_argnames=("k", "p"))
+def _lloyd_loop_packed(x2, sq, valid, centers, k: int, p: int, max_iter, tol):
+    """Lloyd loop over lane-packed data.
+
+    Sub-128-lane bf16 rows read f32-sized HBM on this chip (layout
+    ``T(8,128)(2,1)`` pads the minor dim to 128 lanes — see
+    docs/PERFORMANCE.md).  Packing ``p = 128//f`` samples per 128-lane row
+    (``x2``: (n/p, 128)) makes every pass over the data read the packed
+    bytes: the cross term is one matmul against a block-diagonal centroid
+    matrix (slot s's columns see only feature block s), and the masked
+    centroid sums slice slot s's feature block out of ``one_hot_sᵀ @ x2``.
+    FLOPs grow p-fold on the cross term but the step is memory-bound at
+    small k, so halved traffic wins.  ``sq`` carries per-slot ``|x|²``
+    (n/p, p) f32; ``valid`` masks the zero-padded tail slots.
+    """
+
+    f = x2.shape[1] // p
+
+    def step(centers):
+        cT = centers.astype(x2.dtype).T  # (f, k)
+        w = jnp.zeros((p * f, p * k), x2.dtype)
+        for s in range(p):
+            w = jax.lax.dynamic_update_slice(w, cT, (s * f, s * k))
+        # (n/p, p*k): slot s's distances live in columns [s*k, (s+1)*k)
+        cross = jax.lax.dot_general(
+            x2, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
+        # all slots at once: (n/p, p, k) distances, slot-major one-hots;
+        # clamp like ops_cdist does — f32 rounding across the three terms
+        # can go slightly negative at/near centroids, and a negative
+        # minimum would leak into the reported inertia
+        d2 = jnp.maximum(
+            sq[:, :, None] + cn2[None, None, :] - 2.0 * cross.reshape(-1, p, k),
+            0.0,
+        )
+        labels = jnp.argmin(d2, axis=2)  # (n/p, p)
+        vf = valid[..., None].astype(x2.dtype)
+        oh = (labels[..., None] == jnp.arange(k)[None, None, :]).astype(x2.dtype) * vf
+        counts = jnp.sum(oh, axis=(0, 1), dtype=jnp.float32)
+        inertia = jnp.sum(jnp.min(d2, axis=2) * valid)
+        # ONE masked-sum matmul for every slot: a per-slot dot would read
+        # x2 p times and hand the traffic win straight back
+        all_sums = jax.lax.dot_general(
+            oh.reshape(-1, p * k), x2, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (p*k, p*f); slot s's contribution is its diagonal block
+        sums = jnp.zeros((k, f), jnp.float32)
+        for s in range(p):
+            sums = sums + jax.lax.dynamic_slice(all_sums, (s * k, s * f), (k, f))
+        new_centers = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts, 1)[:, None],
+            centers.astype(jnp.float32),
+        ).astype(centers.dtype)
+        shift = jnp.sum((new_centers - centers).astype(jnp.float32) ** 2)
+        return new_centers, shift, inertia
+
+    return _lloyd_while(step, centers, max_iter, tol)
+
+
+@partial(jax.jit, static_argnames=("p",))
+def _pack_relayout(arr, p: int):
+    """Pad + pack into (n/p, p*f).  Jitted so intermediates fuse (eagerly
+    each op materializes and OOMs the exact large-n case packing exists
+    for).  Kept separate from the |x|² reduce below: one program emitting
+    both the relayout copy and the row reduce sends the TPU compiler into
+    a multi-minute layout-assignment spiral (observed hang at n=1e7)."""
+    n, f = arr.shape
+    n2 = -(-n // p) * p
+    if n2 != n:
+        arr = jnp.pad(arr, ((0, n2 - n), (0, 0)))
+    return arr.reshape(n2 // p, p * f)
+
+
+@partial(jax.jit, static_argnames=("p",))
+def _pack_rownorms(arr, p: int):
+    """Per-slot |x|² (n/p, p) f32 and the validity mask, from the unpacked
+    array (the convert+square fuses into the reduce — no f32 copy)."""
+    n = arr.shape[0]
+    n2 = -(-n // p) * p
+    sq = jnp.sum(arr.astype(jnp.float32) ** 2, axis=1)
+    if n2 != n:
+        sq = jnp.pad(sq, (0, n2 - n))
+    valid = (jnp.arange(n2).reshape(n2 // p, p) < n).astype(jnp.float32)
+    return sq.reshape(n2 // p, p), valid
+
+
+def _pack_kernel(arr, p: int):
+    x2 = _pack_relayout(arr, p)
+    sq, valid = _pack_rownorms(arr, p)
+    return x2, sq, valid
+
+
+def _pack_lanes(arr):
+    """Pack ``p = 128//f`` samples per 128-lane row when profitable:
+    returns ``(x2, sq, valid, f, p)`` or None when not applicable."""
+    n, f = arr.shape
+    if arr.dtype != jnp.bfloat16 or f >= 128 or 128 % f != 0:
+        return None
+    # the conversion holds the lane-padded source (2x logical bytes for
+    # f=64) AND the packed copy; without headroom for both, fall back to
+    # the unpacked loop rather than OOM — packing at ingest (loader level)
+    # is the path for arrays near the HBM ceiling
+    dev = next(iter(arr.devices()))
+    # the array is sharded over the mesh: memory budgets are per device
+    n_dev = max(1, len(arr.devices()))
+    stats = None
+    try:
+        stats = dev.memory_stats()  # None through remote TPU tunnels
+    except Exception:
+        pass
+    free = None
+    if stats:
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use")
+        if limit is not None and in_use is not None:
+            free = limit - in_use
+    if free is not None:
+        if free < arr.size * 2 // n_dev + (1 << 30):
+            return None
+    elif dev.platform == "tpu":
+        # no stats: estimate — lane-padded source (n*128*2B) + packed copy
+        # + loop temporaries must stay well under a 16 GB chip
+        n_ = arr.shape[0]
+        if n_ * (256 + 2 * arr.shape[1]) * 1.3 / n_dev > 12e9:
+            return None
+    p = 128 // f
+    x2, sq, valid = _pack_kernel(arr, p)
+    return x2, sq, valid, f, p
 
 
 class KMeans(_KCluster):
@@ -133,9 +273,17 @@ class KMeans(_KCluster):
             arr = arr.astype(jnp.float32)
         centers = self._cluster_centers.larray.astype(arr.dtype)
 
-        centers, _, inertia, n_iter = _lloyd_loop(
-            arr, centers, self.n_clusters, self.max_iter, self.tol
-        )
+        packed = _pack_lanes(arr)
+        if packed is not None:
+            x2, sq, valid, f, p = packed
+            centers, _, inertia, n_iter = _lloyd_loop_packed(
+                x2, sq, valid, centers, self.n_clusters, p,
+                self.max_iter, self.tol,
+            )
+        else:
+            centers, _, inertia, n_iter = _lloyd_loop(
+                arr, centers, self.n_clusters, self.max_iter, self.tol
+            )
         self._n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray(

@@ -115,3 +115,44 @@ class TestBf16KMeans(TestCase):
         # (n, 1): the reference's keepdims argmin (_kcluster.py:207)
         self.assertEqual(lv.shape, (200, 1))
         self.assertTrue(set(np.unique(lv)) <= {0, 1, 2})
+
+
+class TestPackedLanesKMeans(TestCase):
+    """Lane-packed bf16 Lloyd loop (docs/PERFORMANCE.md design rule: bf16
+    minor dims < 128 read f32-sized HBM; packing p=128//f samples per row
+    restores the bandwidth win)."""
+
+    def test_packed_matches_f32_centers_odd_n(self):
+        rng = np.random.default_rng(0)
+        for n in (999, 1000):
+            X = np.concatenate([
+                rng.normal(-3, 0.3, (n // 2, 64)),
+                rng.normal(3, 0.3, (n - n // 2, 64)),
+            ]).astype(np.float32)
+            kb = ht.cluster.KMeans(n_clusters=2, init="kmeans++", max_iter=50,
+                                   random_state=0)
+            kb.fit(ht.array(X, split=0, dtype=ht.bfloat16))
+            kf = ht.cluster.KMeans(n_clusters=2, init="kmeans++", max_iter=50,
+                                   random_state=0)
+            kf.fit(ht.array(X, split=0))
+            cb = np.sort(np.asarray(kb.cluster_centers_.numpy(), np.float32)[:, 0])
+            cf = np.sort(kf.cluster_centers_.numpy()[:, 0])
+            np.testing.assert_allclose(cb, cf, atol=0.1)
+
+    def test_pack_factor_four(self):
+        rng = np.random.default_rng(1)
+        X = np.concatenate([
+            rng.normal(-3, 0.3, (500, 32)), rng.normal(3, 0.3, (501, 32)),
+        ]).astype(np.float32)
+        k = ht.cluster.KMeans(n_clusters=2, init="kmeans++", max_iter=50,
+                              random_state=0)
+        k.fit(ht.array(X, split=0, dtype=ht.bfloat16))
+        c = np.sort(np.asarray(k.cluster_centers_.numpy(), np.float32)[:, 0])
+        np.testing.assert_allclose(c, [-3, 3], atol=0.2)
+
+    def test_non_divisible_feature_dim_unpacked(self):
+        from heat_tpu.cluster.kmeans import _pack_lanes
+        import jax.numpy as jnp
+
+        self.assertIsNone(_pack_lanes(jnp.zeros((64, 48), jnp.bfloat16)))
+        self.assertIsNone(_pack_lanes(jnp.zeros((64, 64), jnp.float32)))
