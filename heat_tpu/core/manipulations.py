@@ -433,9 +433,37 @@ def tile(x: DNDarray, reps) -> DNDarray:
 
 def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
     """Top-k values and indices (reference: manipulations.py:3830 + custom MPI
-    reduce mpi_topk:3981 — one XLA top_k here)."""
+    reduce mpi_topk:3981).
+
+    Along a split axis this runs shard-local top-k plus one all-gather of
+    the small candidate pool (``parallel/sort.py:distributed_topk``) — the
+    data axis itself is never gathered."""
     sanitation.sanitize_in(a)
     dim = stride_tricks.sanitize_axis(a.shape, dim)
+    if k > a.shape[dim]:
+        # match lax.top_k's behavior on the unsplit path (the distributed
+        # path would otherwise silently return padding sentinels)
+        raise ValueError(f"k={k} exceeds dimension size {a.shape[dim]}")
+    if a.split == dim and a.comm.size > 1 and a.is_distributed():
+        from ..parallel.sort import distributed_topk
+
+        values, indices = distributed_topk(
+            a.parray, a.comm.mesh, a.comm.split_axis, dim, a.shape[dim],
+            int(k), largest,
+        )
+        shape = tuple(int(k) if d == dim else s for d, s in enumerate(a.shape))
+        v = DNDarray(values, shape, a.dtype, None, a.device, a.comm)
+        i = DNDarray(
+            indices.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
+            shape, types.canonical_heat_type(
+                jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+            ), None, a.device, a.comm,
+        )
+        if out is not None:
+            out[0].larray = v.larray
+            out[1].larray = i.larray
+            return out
+        return v, i
     arr = a.larray
     if dim != a.ndim - 1:
         arr = jnp.moveaxis(arr, dim, -1)

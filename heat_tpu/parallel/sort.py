@@ -47,7 +47,7 @@ from jax.sharding import PartitionSpec as P
 
 from .collectives import shard_map_unchecked
 
-__all__ = ["distributed_sort"]
+__all__ = ["distributed_sort", "distributed_topk"]
 
 
 def _apply_order(order, arrs, axis):
@@ -145,6 +145,75 @@ def _build_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads=0):
 @lru_cache(maxsize=None)
 def _jit_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads):
     return jax.jit(_build_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads))
+
+
+def _build_topk(mesh, axis_name, axis, ndim, n_valid, per, k, largest):
+    """Shard_map'd distributed top-k: local top-k per shard (any global
+    winner is in its own shard's local top-k), then one all-gather of the
+    tiny (nshards * min(k, per)) candidate pool — never the data axis
+    (the reference's mpi_topk combiner tree, manipulations.py:3981,
+    restated as a single small collective)."""
+    k_local = min(k, per)
+    in_spec_list = [None] * ndim
+    in_spec_list[axis] = axis_name
+    in_spec = P(*in_spec_list)
+
+    def local(block):
+        r = lax.axis_index(axis_name)
+        vals = jnp.moveaxis(block, axis, -1)
+        dtype = vals.dtype
+        if jnp.issubdtype(dtype, jnp.floating):
+            worst = jnp.array(-jnp.inf if largest else jnp.inf, dtype)
+        elif dtype == jnp.bool_:
+            worst = jnp.array(not largest, dtype)
+        else:
+            info = jnp.iinfo(dtype)
+            worst = jnp.array(info.min if largest else info.max, dtype)
+        pos = r * per + jnp.arange(per)
+        vals = jnp.where(pos >= n_valid, worst, vals)
+        # monotone transform for "smallest": negate floats, bitwise-NOT
+        # ints/bools (~x = -x-1 — bijective, no INT_MIN overflow)
+        if largest:
+            tf = lambda a: a  # noqa: E731
+        elif jnp.issubdtype(dtype, jnp.floating):
+            tf = lambda a: -a  # noqa: E731
+        else:
+            tf = jnp.invert
+        v, i = lax.top_k(tf(vals), k_local)
+        v = tf(v)
+        gi = (i + r * per).astype(jnp.int32)
+        cand_v = lax.all_gather(v, axis_name, axis=v.ndim - 1, tiled=True)
+        cand_i = lax.all_gather(gi, axis_name, axis=gi.ndim - 1, tiled=True)
+        out_v, sel = lax.top_k(tf(cand_v), k)
+        out_v = tf(out_v)
+        out_i = jnp.take_along_axis(cand_i, sel, axis=-1)
+        return jnp.moveaxis(out_v, -1, axis), jnp.moveaxis(out_i, -1, axis)
+
+    return shard_map_unchecked(
+        local, mesh, in_specs=(in_spec,), out_specs=(P(), P())
+    )
+
+
+@lru_cache(maxsize=None)
+def _jit_topk(mesh, axis_name, axis, ndim, n_valid, per, k, largest):
+    return jax.jit(
+        _build_topk(mesh, axis_name, axis, ndim, n_valid, per, k, largest)
+    )
+
+
+def distributed_topk(
+    phys_vals: jax.Array, mesh, axis_name: str, axis: int, n_valid: int,
+    k: int, largest: bool = True,
+):
+    """Top-k along a split ``axis`` without gathering it: returns
+    replicated ``(values, global indices)`` with the k-extent at ``axis``.
+    ``phys_vals`` must carry the canonical even-chunk physical layout."""
+    per = phys_vals.shape[axis] // mesh.shape[axis_name]
+    fn = _jit_topk(
+        mesh, axis_name, axis, phys_vals.ndim, int(n_valid), per, int(k),
+        bool(largest),
+    )
+    return fn(phys_vals)
 
 
 def distributed_sort(

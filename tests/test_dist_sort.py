@@ -279,3 +279,62 @@ class TestDescendingTieOrder(TestCase):
         D = np.array([True, False, True, False, False, True, True, False, True])
         v, _ = ht.sort(ht.array(D, split=0), descending=True)
         np.testing.assert_array_equal(v.numpy(), np.sort(D)[::-1])
+
+
+class TestDistributedTopk(TestCase):
+    """topk along a split axis: shard-local top-k + one small candidate
+    gather (reference: mpi_topk, manipulations.py:3981)."""
+
+    def _check(self, A, k, dim=0, largest=True):
+        x = ht.array(A, split=dim)
+        v, i = ht.topk(x, k, dim=dim, largest=largest)
+        order = np.sort(A, axis=dim)
+        expect = np.flip(order, axis=dim) if largest else order
+        expect = np.take(expect, np.arange(k), axis=dim)
+        np.testing.assert_array_equal(v.numpy(), expect)
+        np.testing.assert_array_equal(
+            np.take_along_axis(A, i.numpy(), dim), v.numpy()
+        )
+        self.assertIsNone(v.split)
+
+    def test_1d_largest_and_smallest(self):
+        rng = np.random.default_rng(20)
+        A = rng.permutation(29).astype(np.float32)
+        self._check(A, 5, largest=True)
+        self._check(A, 5, largest=False)
+
+    def test_k_exceeds_shard_size(self):
+        # 13 elements over 8 devices: per-shard 2, k=7 spans shards
+        rng = np.random.default_rng(21)
+        A = rng.permutation(13).astype(np.float32)
+        self._check(A, 7)
+
+    def test_2d_split0(self):
+        rng = np.random.default_rng(22)
+        A = rng.standard_normal((17, 4)).astype(np.float32)
+        self._check(A, 3, dim=0)
+
+    def test_int_smallest_min_value(self):
+        A = np.array([5, -2**31, 3, 7, -1, 0, 2, 9, 4], dtype=np.int32)
+        x = ht.array(A, split=0)
+        v, i = ht.topk(x, 3, dim=0, largest=False)
+        np.testing.assert_array_equal(v.numpy(), np.sort(A)[:3])
+
+    def test_matches_unsplit_path(self):
+        rng = np.random.default_rng(23)
+        A = rng.standard_normal(26).astype(np.float32)
+        vs, _ = ht.topk(ht.array(A, split=0), 4)
+        vr, _ = ht.topk(ht.array(A), 4)
+        np.testing.assert_array_equal(vs.numpy(), vr.numpy())
+
+    def test_k_too_large_raises(self):
+        x = ht.array(np.arange(13, dtype=np.float32), split=0)
+        with self.assertRaises(ValueError):
+            ht.topk(x, 14)
+
+    def test_bool_dtype(self):
+        A = np.array([True, False, True, False, False, True, True, False, True])
+        v, _ = ht.topk(ht.array(A, split=0), 3)
+        np.testing.assert_array_equal(v.numpy(), [True, True, True])
+        v, _ = ht.topk(ht.array(A, split=0), 3, largest=False)
+        np.testing.assert_array_equal(v.numpy(), [False, False, False])
