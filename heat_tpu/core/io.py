@@ -255,7 +255,14 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
     np_dtype = np.dtype(data.dtype.jax_type())
     with h5py.File(path, mode) as handle:
         if dataset in handle:
-            del handle[dataset]
+            # reference (and plain h5py create_dataset) raise on a name
+            # collision under append modes — silent replacement would be
+            # silent data loss for ported code (advisor round 2).  Mode
+            # 'w' truncates the file first, so it can't reach here.
+            raise ValueError(
+                f"dataset {dataset!r} already exists in {path!r}; "
+                "delete it first or save to a new name"
+            )
         dset = handle.create_dataset(
             dataset, shape=data.shape, dtype=np_dtype, **kwargs
         )

@@ -325,25 +325,56 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
         from ..parallel.sort import distributed_sort
 
         arr = a.parray
+        payloads = ()
         if descending:
             # sort a monotone-decreasing transform of the keys instead of
             # flipping the ascending result: a flip would reverse tie
             # order, making duplicate-value indices differ from the
             # single-device stable descending path (mesh-invariance).
-            # Floats negate (NaNs stay NaN → still ordered last); ints and
-            # bools use bitwise NOT (~k = -k-1, bijective, no INT_MIN
-            # overflow).
+            # Floats need a NaN-aware total-order key — descending sorts
+            # (jnp, reference torch.sort) put NaNs FIRST, but negation
+            # leaves NaN as NaN (ordered last).  IEEE total-order bit
+            # trick: canonicalize NaNs, bitcast to the signed int whose
+            # ascending order equals the float ascending order, then
+            # bitwise-NOT to reverse it (NaN key becomes most negative →
+            # sorts to the global front).  The key transform is lossy
+            # (-0.0 → +0.0, NaN payload bits), so the ORIGINAL values
+            # ride the sort network as an aligned payload and are returned
+            # bit-exact.  Ints and bools use bitwise NOT directly
+            # (~k = -k-1, bijective, no INT_MIN overflow) — exact, no
+            # payload needed.
             if jnp.issubdtype(arr.dtype, jnp.floating):
-                arr, undo = -arr, lambda v: -v
+                int_dtype = jnp.dtype(f"int{jnp.finfo(arr.dtype).bits}")
+                mask = np.array(jnp.iinfo(int_dtype).max, int_dtype)
+
+                def _to_key(v):
+                    v = jnp.where(jnp.isnan(v), jnp.array(jnp.nan, v.dtype), v)
+                    b = jax.lax.bitcast_convert_type(v, int_dtype)
+                    # canonicalize -0.0 (bit pattern == signed int min) to
+                    # +0.0 at the BIT level: keeps ±0 a tie (broken by
+                    # index) like the stable local path.  Float `v + 0`
+                    # would do the same but flushes subnormals to zero on
+                    # TPU, collapsing them into the tie class.
+                    b = jnp.where(
+                        b == np.array(jnp.iinfo(int_dtype).min, int_dtype),
+                        np.array(0, int_dtype),
+                        b,
+                    )
+                    return ~jnp.where(b < 0, b ^ mask, b)
+
+                payloads = (arr,)
+                arr = _to_key(arr)
+                undo = None
             elif arr.dtype == jnp.bool_:
                 arr, undo = ~arr, lambda v: ~v
             else:
                 arr, undo = jnp.invert(arr), jnp.invert
-        values, indices = distributed_sort(
-            arr, a.comm.mesh, a.comm.split_axis, axis, a.shape[axis]
+        values, indices, *rest = distributed_sort(
+            arr, a.comm.mesh, a.comm.split_axis, axis, a.shape[axis],
+            payloads=payloads,
         )
         if descending:
-            values = undo(values)
+            values = rest[0] if payloads else undo(values)
         v = DNDarray(values, a.shape, a.dtype, a.split, a.device, a.comm)
         i = DNDarray(
             indices, a.shape, types.canonical_heat_type(indices.dtype),

@@ -305,6 +305,19 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
         result = _percentile_of_sorted(
             sv.larray, qv, axis_s, x.shape[axis_s], interpolation, keepdims
         )
+        # numpy/jnp percentile propagates NaN; the sorted-selection path
+        # would instead pick a finite value (NaNs sink to the sorted tail).
+        # Mask lanes that contain NaN so split and local paths agree
+        # (advisor round 2).  The sort already established the fact: NaNs
+        # order last among valid elements, so one O(lanes) slice — the
+        # last valid sorted element per lane — is the mask; no extra
+        # full-axis reduction.
+        if jnp.issubdtype(xf.larray.dtype, jnp.floating):
+            last_valid = jnp.take(sv.larray, x.shape[axis_s] - 1, axis=axis_s)
+            nan_lane = jnp.isnan(last_valid)
+            if keepdims:
+                nan_lane = jnp.expand_dims(nan_lane, axis_s)
+            result = jnp.where(nan_lane, jnp.array(jnp.nan, result.dtype), result)
     else:
         result = jnp.percentile(
             x.larray.astype(jnp.float32) if not jnp.issubdtype(x.larray.dtype, jnp.inexact) else x.larray,

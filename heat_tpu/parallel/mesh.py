@@ -220,7 +220,17 @@ class MeshComm(Communication):
             raise ValueError(
                 f"per-device colors must have shape ({self.size},), got {colors.shape}"
             )
-        mine = colors[int(key) % self.size]
+        key = int(key)
+        if not 0 <= key < self.size:
+            # MPI's key is an intra-group ordering hint; here it selects
+            # the perspective position, so a silent modulo wrap would pick
+            # an arbitrary group for MPI-ported `key=rank`-style values
+            # (advisor round 2).  Reject instead.
+            raise ValueError(
+                f"key must be a split-axis position in [0, {self.size}), got {key}; "
+                "use split_groups() for all groups at once"
+            )
+        mine = colors[key]
         return self._submesh([i for i in range(self.size) if colors[i] == mine])
 
     def split_groups(self, colors) -> dict:

@@ -28,11 +28,13 @@ Pads sink to the global tail (their key class orders last), which is
 exactly the canonical physical layout of a split DNDarray, and NaNs keep
 NumPy's "sorted last among valid" position without sentinel arithmetic.
 
-``payloads`` ride along with the keys (1-D keys only): each merge round
-moves whole payload row-blocks with the same ``ppermute`` and reorders them
-with the same argsort — this is the sharded Fisher–Yates replacement
-(sort-by-random-key) behind ``randperm``/``permutation`` and the epoch
-shuffle (reference: random.py:649, utils/data/datatools.py:246).
+``payloads`` ride along with the keys: each merge round moves payload
+blocks with the same ``ppermute`` and reorders them with the same argsort.
+*Aligned* payloads (same shape as the keys) work for any key rank — the
+descending float sort rides its untransformed values this way; *row*
+payloads (extra trailing dims, 1-D keys only) are the sharded Fisher–Yates
+replacement (sort-by-random-key) behind ``randperm``/``permutation`` and
+the epoch shuffle (reference: random.py:649, utils/data/datatools.py:246).
 """
 
 from __future__ import annotations
@@ -76,14 +78,21 @@ def _total_sort(arrs, axis, *, index_presorted=False):
     return _apply_order(order, arrs, axis)
 
 
-def _build_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads=0):
+def _build_sorter(mesh, axis_name, axis, ndim, n_valid, per, payload_ndims=()):
     """Build the shard_map'd odd-even merge-split sorter (jitted once per
-    (mesh, axis, shape-class) through the lru cache below)."""
+    (mesh, axis, shape-class) through the lru cache below).
+
+    Payloads come in two layouts: *aligned* payloads share the key's shape
+    and sharding and are permuted with ``take_along_axis`` (e.g. original
+    float values riding a transformed sort key); *row* payloads (1-D keys
+    only) are axis-0-sharded row blocks moved with a plain ``take``."""
     nshards = mesh.shape[axis_name]
     spec_list = [None] * ndim
     spec_list[axis] = axis_name
     key_spec = P(*spec_list)
-    payload_spec = P(axis_name)  # payloads: rows sharded on their axis 0
+    payload_specs = tuple(
+        key_spec if pnd == ndim else P(axis_name) for pnd in payload_ndims
+    )
 
     def local(phys_vals, *payloads):
         r = lax.axis_index(axis_name)
@@ -137,14 +146,16 @@ def _build_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads=0):
         vals, idxs, _ = arrs[0], arrs[1], arrs[2]
         return (vals, idxs, *arrs[3:])
 
-    in_specs = (key_spec,) + (payload_spec,) * n_payloads
-    out_specs = (key_spec, key_spec) + (payload_spec,) * n_payloads
+    in_specs = (key_spec,) + payload_specs
+    out_specs = (key_spec, key_spec) + payload_specs
     return shard_map_unchecked(local, mesh, in_specs=in_specs, out_specs=out_specs)
 
 
 @lru_cache(maxsize=None)
-def _jit_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads):
-    return jax.jit(_build_sorter(mesh, axis_name, axis, ndim, n_valid, per, n_payloads))
+def _jit_sorter(mesh, axis_name, axis, ndim, n_valid, per, payload_ndims):
+    return jax.jit(
+        _build_sorter(mesh, axis_name, axis, ndim, n_valid, per, payload_ndims)
+    )
 
 
 def _build_topk(mesh, axis_name, axis, ndim, n_valid, per, k, largest):
@@ -226,13 +237,16 @@ def distributed_sort(
     is pad).  Returns ``(values, indices, *payloads)`` in the same physical
     layout: logical elements globally ascending (stable on ties) with pads
     at the global tail, ``indices`` the original global positions along
-    ``axis`` (int32), and every payload reordered by the same permutation
-    (payloads require 1-D keys and axis-0 sharded rows).
+    ``axis`` (int32), and every payload reordered by the same permutation.
+    Aligned payloads (``payload.ndim == phys_vals.ndim``, same shape and
+    sharding as the keys) work for any key rank; row payloads (extra
+    trailing dims, axis-0 sharded) require 1-D keys.
     """
     per = phys_vals.shape[axis] // mesh.shape[axis_name]
-    if payloads and phys_vals.ndim != 1:
-        raise ValueError("payloads require 1-D sort keys")
+    payload_ndims = tuple(p.ndim for p in payloads)
+    if any(pnd != phys_vals.ndim for pnd in payload_ndims) and phys_vals.ndim != 1:
+        raise ValueError("row payloads require 1-D sort keys")
     fn = _jit_sorter(
-        mesh, axis_name, axis, phys_vals.ndim, int(n_valid), per, len(payloads)
+        mesh, axis_name, axis, phys_vals.ndim, int(n_valid), per, payload_ndims
     )
     return fn(phys_vals, *payloads)

@@ -58,6 +58,64 @@ class TestDistributedSortOracle(TestCase):
         v, _ = ht.sort(ht.array(A, split=0))
         np.testing.assert_allclose(v.numpy(), np.sort(A), rtol=1e-6)
 
+    def test_nan_descending_first_matches_local(self):
+        # advisor round 2 (medium): descending sort of NaN-bearing floats
+        # must put NaNs FIRST on every path; the distributed branch's plain
+        # negation left them at the tail, breaking mesh-invariance
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal(19).astype(np.float32)
+        A[3] = A[7] = A[12] = np.nan
+        v_split, i_split = ht.sort(ht.array(A, split=0), descending=True)
+        v_local, i_local = ht.sort(ht.array(A), descending=True)
+        np.testing.assert_array_equal(v_split.numpy(), v_local.numpy())
+        np.testing.assert_array_equal(i_split.numpy(), i_local.numpy())
+        self.assertTrue(np.isnan(v_split.numpy()[:3]).all())
+        self.assertFalse(np.isnan(v_split.numpy()[3:]).any())
+
+    def test_nan_descending_2d_split0(self):
+        rng = np.random.default_rng(7)
+        A = rng.standard_normal((11, 3)).astype(np.float32)
+        A[2, 1] = A[9, 0] = np.nan
+        v, _ = ht.sort(ht.array(A, split=0), axis=0, descending=True)
+        expect = np.flip(np.sort(A, axis=0), axis=0)
+        np.testing.assert_array_equal(v.numpy(), expect)
+
+    def test_descending_signed_zero_tie_matches_local(self):
+        # ±0 compare equal; the stable tiebreak (original index) must win,
+        # not the IEEE total order of the descending bit-key — and the
+        # returned VALUES must keep their sign bits (the lossy key
+        # transform must not leak into the output; code review round 3)
+        A = np.array([1.0, -0.0, 3.0, 0.0, -0.0, 2.0, 0.0], dtype=np.float32)
+        v_split, i_split = ht.sort(ht.array(A, split=0), descending=True)
+        v_local, i_local = ht.sort(ht.array(A), descending=True)
+        np.testing.assert_array_equal(i_split.numpy(), i_local.numpy())
+        np.testing.assert_array_equal(v_split.numpy(), v_local.numpy())
+        np.testing.assert_array_equal(
+            np.signbit(v_split.numpy()), np.signbit(v_local.numpy())
+        )
+        # the multiset of bit patterns is exactly the input's
+        self.assertEqual(
+            sorted(v_split.numpy().view(np.int32).tolist()),
+            sorted(A.view(np.int32).tolist()),
+        )
+
+    def test_descending_subnormals_not_collapsed(self):
+        # the ±0 canonicalization must be bit-level: a float `v + 0` would
+        # flush subnormals to zero and collapse them into the zero tie
+        # class (code review round 3).  The oracle is NUMPY, not the local
+        # jnp path: XLA comparisons flush denormals on CPU and TPU (DAZ),
+        # so the local path itself collapses subnormal ties — the bit-key
+        # distributed path is the one that matches numpy/the reference's
+        # strict ordering.
+        A = np.array([-0.0, 1e-40, 0.0, -1e-40, 1.0], dtype=np.float32)
+        v_split, i_split = ht.sort(ht.array(A, split=0), descending=True)
+        # numpy strict descending with stable ±0 tie: 1.0, 1e-40, -0.0,
+        # 0.0, -1e-40  →  original indices [4, 1, 0, 2, 3]
+        np.testing.assert_array_equal(i_split.numpy(), [4, 1, 0, 2, 3])
+        np.testing.assert_array_equal(
+            v_split.numpy().view(np.int32), A[[4, 1, 0, 2, 3]].view(np.int32)
+        )
+
     def test_smaller_than_mesh(self):
         # 3 elements over 8 devices: most shards all-pad
         self._check(np.array([3.0, 1.0, 2.0], dtype=np.float32))
@@ -125,6 +183,35 @@ class TestDistributedPercentile(TestCase):
         np.testing.assert_allclose(
             got.numpy(), np.percentile(B, 50.0, axis=0, keepdims=True),
             rtol=1e-5,
+        )
+
+    def test_nan_propagates_like_numpy(self):
+        # advisor round 2: the sorted-selection split path sank NaNs to the
+        # tail and returned a finite value where numpy/jnp return NaN
+        rng = np.random.default_rng(10)
+        A = rng.standard_normal(21).astype(np.float32)
+        A[4] = np.nan
+        for x in (ht.array(A, split=0), ht.array(A)):
+            got = float(ht.percentile(x, 50.0))
+            self.assertTrue(np.isnan(got))
+
+    def test_nan_propagates_per_lane_2d(self):
+        rng = np.random.default_rng(11)
+        B = rng.standard_normal((14, 3)).astype(np.float32)
+        B[5, 1] = np.nan  # only column 1 becomes NaN
+        got = ht.percentile(ht.array(B, split=0), 25.0, axis=0).numpy()
+        expect = np.percentile(B, 25.0, axis=0)
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+        self.assertTrue(np.isnan(got[1]))
+        self.assertFalse(np.isnan(got[[0, 2]]).any())
+
+    def test_nan_vector_q(self):
+        rng = np.random.default_rng(12)
+        B = rng.standard_normal((10, 4)).astype(np.float32)
+        B[3, 2] = np.nan
+        got = ht.percentile(ht.array(B, split=0), [25.0, 75.0], axis=0).numpy()
+        np.testing.assert_allclose(
+            got, np.percentile(B, [25.0, 75.0], axis=0), rtol=1e-5
         )
 
 
@@ -233,7 +320,7 @@ class TestShardedPermutation(TestCase):
         comm = sanitize_comm(None)
         per = 2
         n = per * comm.size
-        fn = _build_sorter(comm.mesh, comm.split_axis, 0, 1, n, per, n_payloads=1)
+        fn = _build_sorter(comm.mesh, comm.split_axis, 0, 1, n, per, payload_ndims=(2,))
         keys = jax.device_put(
             np.arange(n, dtype=np.float32), comm.sharding(0, 1)
         )

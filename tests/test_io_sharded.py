@@ -97,14 +97,26 @@ class TestShardedHDF5(TestCase):
             z = htio.load_hdf5(path, "DATA", split=0, slices=(None, slice(1, 4)))
             np.testing.assert_allclose(z.numpy(), A[:, 1:4], rtol=1e-6)
 
-    def test_save_append_mode_replaces_dataset(self):
+    def test_save_append_mode_raises_on_existing_dataset(self):
+        # reference/h5py semantics: create_dataset on an existing name under
+        # append modes raises — silent replacement would be silent data loss
         A = np.arange(12, dtype=np.float32).reshape(4, 3)
         with tempfile.TemporaryDirectory() as d:
             path = os.path.join(d, "t.h5")
             ht.save(ht.array(A, split=0), path, "DATA")
-            ht.save(ht.array(A * 2, split=0), path, "DATA", mode="a")
+            with self.assertRaises(ValueError):
+                ht.save(ht.array(A * 2, split=0), path, "DATA", mode="a")
+            # original data untouched
             y = ht.load(path, dataset="DATA", split=0)
-            np.testing.assert_allclose(y.numpy(), A * 2, rtol=1e-6)
+            np.testing.assert_allclose(y.numpy(), A, rtol=1e-6)
+            # a different dataset name in the same file is fine
+            ht.save(ht.array(A * 2, split=0), path, "DATA2", mode="a")
+            z = ht.load(path, dataset="DATA2", split=0)
+            np.testing.assert_allclose(z.numpy(), A * 2, rtol=1e-6)
+            # mode 'w' recreates the file, so same-name save succeeds
+            ht.save(ht.array(A * 3, split=0), path, "DATA", mode="w")
+            w = ht.load(path, dataset="DATA", split=0)
+            np.testing.assert_allclose(w.numpy(), A * 3, rtol=1e-6)
 
     def test_docstring_matches_behavior(self):
         # round-1 review: the docstring advertised slab loading while the

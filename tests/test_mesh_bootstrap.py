@@ -147,6 +147,18 @@ class TestMeshCommSplit(TestCase):
         with self.assertRaises(ValueError):
             comm.Split([0, 1])  # wrong length
 
+    def test_out_of_range_key_rejected(self):
+        # advisor round 2: MPI-ported `key=rank`-style ordering keys must
+        # not silently modulo-wrap into an arbitrary color group
+        from heat_tpu.parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(None)
+        colors = [i % 2 for i in range(comm.size)]
+        with self.assertRaises(ValueError):
+            comm.Split(colors, key=comm.size)
+        with self.assertRaises(ValueError):
+            comm.Split(colors, key=-1)
+
     def test_estimator_fit_on_submesh(self):
         """Consumer: a sub-communicator scopes an estimator's collectives to
         a device subset (the reference's reason for Split)."""
