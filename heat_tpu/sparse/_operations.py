@@ -1,31 +1,181 @@
 """Sparse op machinery (reference: heat/sparse/_operations.py:17).
 
-Sparse structure math (union of patterns for add, intersection for mul) is
-index bookkeeping, not FLOPs — scipy on host computes the result pattern and
-the payload lands back on device. Dense-side work stays on the TPU.
+The reference computes elementwise CSR results in torch on each rank's row
+chunk.  The TPU redesign keeps that shard-locality — each row's result
+depends only on that row's two inputs, so a split=0 op needs NO collective
+— and does the sparse structure math (union of patterns for add,
+intersection for mul) ON DEVICE as static-shape sort/scan over the padded
+per-shard COO triples:
+
+1. expand each operand's row pointers to per-entry row ids (invalid pad
+   entries get the sentinel row ``nrows``),
+2. concatenate the two operands (a first — the stable tiebreak) and sort
+   by (row, col) with two stable argsort passes (no wide fused key, so no
+   int64 dependence),
+3. adjacent equal (row, col) pairs are entries present in both operands:
+   add sums the pair and keeps the first, mul multiplies and keeps only
+   pairs; explicit zeros are dropped (scipy's ``eliminate_zeros``),
+4. compact survivors to the front with one more stable argsort and read
+   the new row pointers off the sorted row ids with ``searchsorted``.
+
+Everything is static-shape (output capacity = cap_a + cap_b, trimmed to
+the max shard nnz afterwards); scipy appears nowhere in the op path.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..core import types
-from .dcsr_matrix import DCSR_matrix
+from ..parallel.collectives import shard_map_unchecked
 
 __all__ = []
 
 
-def _binary_op_csr(operation: Callable, t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
-    """Elementwise CSR-CSR operation (reference: _operations.py:17)."""
+def _expand_rows(indptr: jax.Array, cap: int, nrows: int) -> jax.Array:
+    """Per-entry local row id for a padded CSR slab: entry positions past
+    ``indptr[-1]`` (the pad) get the sentinel row ``nrows``."""
+    lnnz = indptr[-1]
+    e = jnp.arange(cap, dtype=indptr.dtype)
+    rows = jnp.searchsorted(indptr, e, side="right") - 1
+    return jnp.where(e < lnnz, rows, nrows).astype(jnp.int32)
+
+
+def _apply(order, arrs):
+    return [jnp.take(a, order, axis=0) for a in arrs]
+
+
+def _merge_local(mode, da, ia, pa, db, ib, pb, nrows):
+    """Merge two padded local CSR slabs elementwise; returns padded
+    ``(vals, cols, indptr, lnnz)`` with capacity ``cap_a + cap_b``."""
+    cap_a, cap_b = da.shape[0], db.shape[0]
+    ra = _expand_rows(pa, cap_a, nrows)
+    rb = _expand_rows(pb, cap_b, nrows)
+    rows = jnp.concatenate((ra, rb))
+    cols = jnp.concatenate((ia, ib)).astype(jnp.int32)
+    vals = jnp.concatenate((da, db))
+
+    # sort by (row, col), stable: col pass first, then row pass.  The
+    # initial a-then-b concatenation order makes equal (row, col) pairs
+    # come out a-first — the deterministic operand order for the combine.
+    order = jnp.argsort(cols, stable=True)
+    rows, cols, vals = _apply(order, [rows, cols, vals])
+    order = jnp.argsort(rows, stable=True)
+    rows, cols, vals = _apply(order, [rows, cols, vals])
+
+    valid = rows < nrows
+    same_next = (
+        (rows == jnp.roll(rows, -1)) & (cols == jnp.roll(cols, -1)) & valid
+    )
+    same_next = same_next.at[-1].set(False)
+    same_prev = jnp.roll(same_next, 1).at[0].set(False)
+    nxt_vals = jnp.roll(vals, -1)
+    if mode == "add":
+        out_vals = vals + jnp.where(same_next, nxt_vals, jnp.zeros_like(vals))
+        keep = valid & ~same_prev
+    elif mode == "mul":
+        out_vals = vals * nxt_vals
+        keep = same_next  # intersection: first entry of each pair
+    else:  # pragma: no cover
+        raise ValueError(f"unknown sparse op {mode!r}")
+    # stored-zero elimination (reference runs scipy's eliminate_zeros)
+    keep = keep & (out_vals != 0)
+
+    # compact survivors to the front, preserving (row, col) order
+    order = jnp.argsort(~keep, stable=True)
+    keep_c, rows_c, cols_c, vals_c = _apply(order, [keep, rows, cols, out_vals])
+    rows_c = jnp.where(keep_c, rows_c, nrows)
+    cols_c = jnp.where(keep_c, cols_c, 0)
+    vals_c = jnp.where(keep_c, vals_c, jnp.zeros_like(vals_c))
+    indptr = jnp.searchsorted(
+        rows_c, jnp.arange(nrows + 1, dtype=jnp.int32), side="left"
+    ).astype(pa.dtype)
+    lnnz = keep.sum(dtype=jnp.int32)
+    return vals_c, cols_c, indptr, lnnz
+
+
+@lru_cache(maxsize=None)
+def _jit_merge_sharded(mesh, axis_name, mode, nrows, out_dtype):
+    """Shard_map'd + jitted merge over (S, cap) slabs: purely shard-local
+    — the compiled program contains no collective at all."""
+    spec = P(axis_name, None)
+
+    def local(da, ia, pa, db, ib, pb):
+        v, c, p, n = _merge_local(
+            mode,
+            da[0].astype(out_dtype), ia[0], pa[0],
+            db[0].astype(out_dtype), ib[0], pb[0],
+            nrows,
+        )
+        return v[None], c[None], p[None], n[None]
+
+    fn = shard_map_unchecked(
+        local,
+        mesh,
+        in_specs=(spec,) * 6,
+        out_specs=(spec, spec, spec, P(axis_name)),
+    )
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _jit_merge_local(mode, nrows, out_dtype):
+    def run(da, ia, pa, db, ib, pb):
+        return _merge_local(
+            mode, da.astype(out_dtype), ia, pa, db.astype(out_dtype), ib, pb,
+            nrows,
+        )
+
+    return jax.jit(run)
+
+
+def _binary_op_csr(mode: str, t1, t2):
+    """Elementwise CSR-CSR operation (reference: _operations.py:17) —
+    shard-local, on-device; see the module docstring."""
+    from .dcsr_matrix import DCSR_matrix
+
     if not isinstance(t1, DCSR_matrix) or not isinstance(t2, DCSR_matrix):
         raise TypeError(f"inputs must be DCSR_matrix, got {type(t1)}, {type(t2)}")
     if t1.shape != t2.shape:
         raise ValueError(f"shapes do not match: {t1.shape} vs {t2.shape}")
-    a = t1.to_scipy()
-    b = t2.to_scipy()
-    result = operation(a, b).tocsr()
-    result.eliminate_zeros()
-    from .factories import sparse_csr_matrix
-
     out_split = t1.split if t1.split is not None else t2.split
-    return sparse_csr_matrix(result, split=out_split, device=t1.device, comm=t1.comm)
+    if t1.split != t2.split:
+        # align: reconstruct the differently-split operand in t-split form
+        # (row chunking is metadata here — the payload move is a resplit)
+        t2 = t2.resplit(t1.split) if t1.split is not None else t2
+        t1 = t1.resplit(out_split)
+
+    out_dtype = types.promote_types(t1.dtype, t2.dtype)
+    jt = out_dtype.jax_type()
+    distributed = out_split == 0 and t1.comm.size > 1
+
+    if distributed:
+        fn = _jit_merge_sharded(
+            t1.comm.mesh, t1.comm.split_axis, mode, t1.rows_per_shard, jt
+        )
+        vals, cols, indptr, lnnz = fn(
+            t1._data, t1._indices, t1._lindptr,
+            t2._data, t2._indices, t2._lindptr,
+        )
+    else:
+        fn = _jit_merge_local(mode, t1.shape[0], jt)
+        v, c, p, n = fn(
+            t1._data[0], t1._indices[0], t1._lindptr[0],
+            t2._data[0], t2._indices[0], t2._lindptr[0],
+        )
+        vals, cols, indptr, lnnz = v[None], c[None], p[None], n[None]
+
+    lnnz_host = tuple(int(x) for x in np.asarray(lnnz))
+    from .dcsr_matrix import DCSR_matrix as _D
+
+    out = _D._from_shards(
+        vals, cols, indptr, lnnz_host, t1.shape, out_dtype, out_split,
+        t1.device, t1.comm,
+    )
+    return out.trim()

@@ -10,14 +10,12 @@ __all__ = ["add", "mul"]
 
 
 def add(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
-    """Elementwise sparse addition (reference: arithmetics.py:16)."""
-    import operator
-
-    return _binary_op_csr(operator.add, t1, t2)
+    """Elementwise sparse addition — pattern union, shard-local on device
+    (reference: arithmetics.py:16)."""
+    return _binary_op_csr("add", t1, t2)
 
 
 def mul(t1: DCSR_matrix, t2: DCSR_matrix) -> DCSR_matrix:
-    """Elementwise sparse multiplication (reference: arithmetics.py:54).
-    scipy's ``*`` is matmul for sparse matrices; ``.multiply`` is the
-    elementwise (Hadamard) product."""
-    return _binary_op_csr(lambda a, b: a.multiply(b), t1, t2)
+    """Elementwise (Hadamard) sparse multiplication — pattern
+    intersection, shard-local on device (reference: arithmetics.py:54)."""
+    return _binary_op_csr("mul", t1, t2)

@@ -1,4 +1,11 @@
-"""Sparse factories (reference: heat/sparse/factories.py:23)."""
+"""Sparse factories (reference: heat/sparse/factories.py:23).
+
+Construction chunks the rows per the even-chunk rule and places each
+shard's padded (data, indices, rebased indptr) slab on its device —
+the sparse counterpart of the dense slab loader (core/io.py): the
+assembled (S, cap) host staging is per-shard slabs, never a densified
+matrix, and after ``device_put`` each device holds only its own chunk.
+"""
 
 from __future__ import annotations
 
@@ -6,8 +13,8 @@ from typing import Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
-from jax.experimental import sparse as jsparse
 
 from ..core import devices as ht_devices
 from ..core import types
@@ -37,8 +44,16 @@ def sparse_csr_matrix(
         sp = obj.to_scipy()
     elif scipy.sparse.issparse(obj):
         sp = obj.tocsr()
+        if sp is obj:
+            # tocsr() on an already-CSR input returns the SAME object;
+            # canonicalization below must not mutate the caller's arrays
+            sp = sp.copy()
     else:
         sp = scipy.sparse.csr_matrix(np.asarray(obj))
+    # canonical form: the on-device merge kernel assumes sorted column
+    # order and unique (row, col) entries per operand
+    sp.sum_duplicates()
+    sp.sort_indices()
 
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
@@ -47,12 +62,45 @@ def sparse_csr_matrix(
     if split not in (None, 0) or is_split not in (None, 0):
         raise ValueError("sparse matrices support split=0 (row chunks) only")
     final_split = 0 if (split == 0 or is_split == 0) else None
-
-    arr = jsparse.BCSR(
-        (jnp.asarray(sp.data), jnp.asarray(sp.indices), jnp.asarray(sp.indptr)),
-        shape=sp.shape,
-    )
     heat_type = types.canonical_heat_type(sp.data.dtype) if dtype is None else dtype
+
+    nrows, ncols = sp.shape
+    nsh = comm.size if (final_split == 0 and comm.size > 1) else 1
+    rows_per = -(-nrows // nsh) if nrows else 0
+
+    # per-shard slabs: rebased indptr over the physical rows_per rows
+    # (trailing rows repeat the end value), data/indices padded to the
+    # common capacity
+    lnnz = []
+    ptrs = np.zeros((nsh, rows_per + 1), np.int32)
+    for r in range(nsh):
+        lo = min(r * rows_per, nrows)
+        hi = min((r + 1) * rows_per, nrows)
+        seg = sp.indptr[lo : hi + 1].astype(np.int64)
+        base = int(seg[0]) if len(seg) else 0
+        reb = (seg - base).astype(np.int32)
+        ptrs[r, : len(reb)] = reb
+        ptrs[r, len(reb) :] = reb[-1] if len(reb) else 0
+        lnnz.append(int(sp.indptr[hi] - sp.indptr[lo]))
+    cap = max(1, max(lnnz, default=1))
+    datas = np.zeros((nsh, cap), sp.data.dtype)
+    idxs = np.zeros((nsh, cap), np.int32)
+    for r in range(nsh):
+        lo = min(r * rows_per, nrows)
+        hi = min((r + 1) * rows_per, nrows)
+        a, b = int(sp.indptr[lo]), int(sp.indptr[hi])
+        datas[r, : b - a] = sp.data[a:b]
+        idxs[r, : b - a] = sp.indices[a:b]
+
+    if final_split == 0 and comm.size > 1:
+        sh2 = comm.sharding(0, 2)
+    else:
+        sh2 = comm.replicated(2)
+    data = jax.device_put(jnp.asarray(datas), sh2)
+    indices = jax.device_put(jnp.asarray(idxs), sh2)
+    lindptr = jax.device_put(jnp.asarray(ptrs), sh2)
+
     return DCSR_matrix(
-        arr, int(sp.nnz), tuple(sp.shape), heat_type, final_split, device, comm
+        (data, indices, lindptr, tuple(lnnz)), int(sp.nnz), (nrows, ncols),
+        heat_type, final_split, device, comm,
     )

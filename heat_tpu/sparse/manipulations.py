@@ -1,26 +1,82 @@
-"""Sparse manipulations (reference: heat/sparse/manipulations.py:15)."""
+"""Sparse manipulations (reference: heat/sparse/manipulations.py:15).
+
+``todense`` scatters each shard's COO triples into that shard's dense row
+block on device (``.at[].add`` with out-of-bounds pad rows dropped) — the
+result is a row-split dense DNDarray and the global dense matrix never
+exists in one place.
+"""
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Optional
 
-from ..core import factories, types
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
 from ..core.dndarray import DNDarray
+from ..parallel.collectives import shard_map_unchecked
+from ._operations import _expand_rows
 from .dcsr_matrix import DCSR_matrix
 
 __all__ = ["todense", "to_dense"]
 
 
+def _scatter_block(data, idx, ptr, rows_per, ncols):
+    cap = data.shape[0]
+    rows = _expand_rows(ptr, cap, rows_per)  # pad entries -> sentinel row
+    block = jnp.zeros((rows_per, ncols), data.dtype)
+    # sentinel row == rows_per is out of bounds: mode="drop" discards pads
+    return block.at[rows, idx].add(data, mode="drop")
+
+
+@lru_cache(maxsize=None)
+def _jit_scatter_sharded(mesh, axis_name, rows_per, ncols):
+    spec = P(axis_name, None)
+
+    def local(data, idx, ptr):
+        return _scatter_block(data[0], idx[0], ptr[0], rows_per, ncols)
+
+    return jax.jit(
+        shard_map_unchecked(
+            local, mesh, in_specs=(spec,) * 3, out_specs=P(axis_name, None)
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _jit_scatter_local(nrows, ncols):
+    return jax.jit(
+        lambda data, idx, ptr: _scatter_block(data, idx, ptr, nrows, ncols)
+    )
+
+
 def todense(sparse_matrix: DCSR_matrix, order: str = "C", out: Optional[DNDarray] = None) -> DNDarray:
     """Densify into a row-split DNDarray (reference: manipulations.py:15)."""
-    dense = sparse_matrix.larray.todense()
-    result = factories.array(
-        dense,
-        dtype=sparse_matrix.dtype,
-        split=sparse_matrix.split,
-        device=sparse_matrix.device,
-        comm=sparse_matrix.comm,
-    )
+    nrows, ncols = sparse_matrix.shape
+    comm = sparse_matrix.comm
+    if sparse_matrix.split == 0 and comm.size > 1:
+        fn = _jit_scatter_sharded(
+            comm.mesh, comm.split_axis, sparse_matrix.rows_per_shard, ncols
+        )
+        phys = fn(
+            sparse_matrix._data, sparse_matrix._indices, sparse_matrix._lindptr
+        )
+        result = DNDarray(
+            phys, (nrows, ncols), sparse_matrix.dtype, 0,
+            sparse_matrix.device, comm,
+        )
+    else:
+        fn = _jit_scatter_local(nrows, ncols)
+        dense = fn(
+            sparse_matrix._data[0], sparse_matrix._indices[0],
+            sparse_matrix._lindptr[0],
+        )
+        result = DNDarray(
+            dense, (nrows, ncols), sparse_matrix.dtype, sparse_matrix.split,
+            sparse_matrix.device, comm,
+        )
     if out is not None:
         from ..core import sanitation
 
