@@ -14,6 +14,7 @@ operand's layout.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import numpy as np
@@ -124,26 +125,105 @@ def outer(a: DNDarray, b: DNDarray, out=None, split=None) -> DNDarray:
     return wrapped
 
 
+@partial(jax.jit, static_argnames=("n",))
+def _pp_lu_det(arr, n: int):
+    """Determinant by partial-pivoting Gaussian elimination, fused into
+    ONE program: a fori_loop over columns — per column an argmax pivot
+    search, a two-row swap, and a masked rank-1 update.  The reference
+    eliminates rows with a host ``.item()`` sync and a Bcast per pivot
+    (basics.py:160-312); here the n-iteration loop never leaves the
+    device, and under GSPMD with a split matrix each update is local
+    shard work plus the pivot row's broadcast — the same dataflow, XLA
+    inserting the collectives."""
+
+    def body(i, carry):
+        A, det, sign = carry
+        col = jax.lax.dynamic_slice_in_dim(A, i, 1, 1)[:, 0]
+        cand = jnp.where(jnp.arange(n) >= i, jnp.abs(col), -jnp.inf)
+        j = jnp.argmax(cand)
+        ri = jax.lax.dynamic_index_in_dim(A, i, 0, keepdims=False)
+        rj = jax.lax.dynamic_index_in_dim(A, j, 0, keepdims=False)
+        A = jax.lax.dynamic_update_index_in_dim(A, rj, i, 0)
+        A = jax.lax.dynamic_update_index_in_dim(A, ri, j, 0)
+        sign = jnp.where(j != i, -sign, sign)
+        piv = jax.lax.dynamic_index_in_dim(rj, i, 0, keepdims=False)
+        det = det * piv
+        denom = jnp.where(piv == 0, jnp.ones_like(piv), piv)
+        colp = jax.lax.dynamic_slice_in_dim(A, i, 1, 1)[:, 0]
+        z = jnp.where(jnp.arange(n) > i, colp / denom, jnp.zeros_like(colp))
+        A = A - z[:, None] * rj[None, :]
+        return A, det, sign
+
+    one = jnp.ones((), arr.dtype)
+    A, det, sign = jax.lax.fori_loop(0, n, body, (arr, one, one))
+    return det * sign
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _gj_inv(arr, n: int):
+    """Inverse by partial-pivoting Gauss-Jordan on the augmented
+    ``[A | I]``, fused like :func:`_pp_lu_det`.  Row-split inputs keep
+    the augmented matrix row-split; the right half is A^-1."""
+    aug = jnp.concatenate([arr, jnp.eye(n, dtype=arr.dtype)], axis=1)
+
+    def body(i, aug):
+        col = jax.lax.dynamic_slice_in_dim(aug, i, 1, 1)[:, 0]
+        cand = jnp.where(jnp.arange(n) >= i, jnp.abs(col), -jnp.inf)
+        j = jnp.argmax(cand)
+        ri = jax.lax.dynamic_index_in_dim(aug, i, 0, keepdims=False)
+        rj = jax.lax.dynamic_index_in_dim(aug, j, 0, keepdims=False)
+        aug = jax.lax.dynamic_update_index_in_dim(aug, rj, i, 0)
+        aug = jax.lax.dynamic_update_index_in_dim(aug, ri, j, 0)
+        piv = jax.lax.dynamic_index_in_dim(rj, i, 0, keepdims=False)
+        # no zero-pivot masking: a singular matrix must surface as
+        # inf/NaN (matching XLA's inv), not as a finite wrong inverse
+        pr = rj / piv
+        # eliminate every OTHER row, then place the scaled pivot row
+        colp = jax.lax.dynamic_slice_in_dim(aug, i, 1, 1)[:, 0]
+        z = jnp.where(jnp.arange(n) != i, colp, jnp.zeros_like(colp))
+        aug = aug - z[:, None] * pr[None, :]
+        aug = jax.lax.dynamic_update_index_in_dim(aug, pr, i, 0)
+        return aug
+
+    aug = jax.lax.fori_loop(0, n, body, aug)
+    return aug[:, n:]
+
+
 def det(a: DNDarray) -> DNDarray:
-    """Determinant (reference: basics.py:160 — distributed row elimination
-    with per-pivot Bcast; XLA's LU on the global array here)."""
+    """Determinant (reference: basics.py:160).  2-D matrices — split or
+    not — go through the fused distributed elimination; stacks (batched)
+    are local XLA LU per matrix."""
     sanitation.sanitize_in(a)
     _square_check(a)
     arr = a.larray
     if not jnp.issubdtype(arr.dtype, jnp.inexact):
         arr = arr.astype(jnp.float32)
-    result = jnp.linalg.det(arr)
+    if a.ndim == 2 and a.split is not None and a.is_distributed():
+        # split=1: det(A) = det(A^T) and the transpose is row-split
+        result = _pp_lu_det(arr.T if a.split == 1 else arr, a.shape[-1])
+    else:
+        # local (and batched) matrices keep XLA's blocked LU kernel — the
+        # serial elimination loop is for matrices one device can't hold
+        result = jnp.linalg.det(arr)
     return DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), None, a.device, a.comm)
 
 
 def inv(a: DNDarray) -> DNDarray:
-    """Matrix inverse (reference: basics.py:312)."""
+    """Matrix inverse (reference: basics.py:312).  2-D matrices go
+    through the fused distributed Gauss-Jordan; stacks are local."""
     sanitation.sanitize_in(a)
     _square_check(a)
     arr = a.larray
     if not jnp.issubdtype(arr.dtype, jnp.inexact):
         arr = arr.astype(jnp.float32)
-    result = jnp.linalg.inv(arr)
+    if a.ndim == 2 and a.split is not None and a.is_distributed():
+        if a.split == 1:
+            # inv(A) = inv(A^T)^T over the row-split transpose
+            result = _gj_inv(arr.T, a.shape[-1]).T
+        else:
+            result = _gj_inv(arr, a.shape[-1])
+    else:
+        result = jnp.linalg.inv(arr)
     out = DNDarray(
         result, tuple(result.shape), types.canonical_heat_type(result.dtype),
         a.split, a.device, a.comm,
