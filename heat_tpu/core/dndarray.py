@@ -419,30 +419,89 @@ class DNDarray:
                 )
         return self
 
-    def get_halo(self, halo_size: int):
-        """The reference exchanges halos eagerly (dndarray.py:383-453). On TPU
-        halos materialize inside compiled stencils; see
-        heat_tpu/ops/halo.py for the shard_map-level exchange."""
-        raise NotImplementedError(
-            "eager halo buffers do not exist under XLA; use heat_tpu.ops.halo "
-            "or a sharded convolution, which gets halos from the partitioner"
-        )
+    def get_halo(self, halo_size: int) -> None:
+        """Fetch halos of size ``halo_size`` from split-axis neighbors into
+        ``halo_prev``/``halo_next`` (reference: dndarray.py:383-453).
+
+        The reference posts per-rank Isend/Irecv pairs; here ONE compiled
+        exchange (``ops/halo.exchange_halos`` — a pair of
+        collective-permutes riding neighboring ICI links) materializes
+        every shard's slabs at once, and the single-controller accessors
+        expose them: :attr:`halo_prev`/:attr:`halo_next` give the calling
+        rank's view (populated-rank rules as in the reference — edge
+        shards get ``None``), :meth:`shard_halos` gives any shard's."""
+        if not isinstance(halo_size, int):
+            raise TypeError(
+                f"halo_size needs to be of Python type integer, {type(halo_size)} given"
+            )
+        if halo_size < 0:
+            raise ValueError(
+                f"halo_size needs to be a positive Python integer, {halo_size} given"
+            )
+        if not self.is_distributed() or halo_size == 0:
+            return
+        lmap = self.lshape_map[:, self.__split]
+        populated = np.nonzero(lmap)[0]
+        if len(populated) and (halo_size > lmap[populated]).any():
+            raise ValueError(
+                f"halo_size {halo_size} needs to be smaller than chunk-size "
+                f"{int(lmap[populated].min())} )"
+            )
+        from ..ops.halo import exchange_halos
+
+        prev_all, next_all = exchange_halos(self, halo_size)
+        self.__halos = (halo_size, prev_all, next_all, populated)
+
+    def shard_halos(self, rank: int):
+        """(halo_prev, halo_next) of one shard after :meth:`get_halo` —
+        ``None`` at the populated-rank edges, exactly the reference's
+        per-rank state (the single-controller face of the API)."""
+        halos = getattr(self, "_DNDarray__halos", None)
+        if halos is None:
+            return None, None
+        halo_size, prev_all, next_all, populated = halos
+        if rank not in populated:
+            return None, None
+        sel = slice(rank * halo_size, (rank + 1) * halo_size)
+
+        def view(block):
+            out = jnp.asarray(block[sel])
+            if self.__split != 0:
+                out = jnp.moveaxis(out, 0, self.__split)
+            return out
+
+        prev = None if rank == populated[0] else view(prev_all)
+        nxt = None if rank == populated[-1] else view(next_all)
+        return prev, nxt
 
     @property
     def halo_prev(self):
-        """No eager halo is ever attached (see :meth:`get_halo`); matches the
-        reference's state before any exchange (dndarray.py:355-382)."""
-        return None
+        """This rank's previous-neighbor slab (``None`` before
+        :meth:`get_halo`, at the first populated rank, and on unpopulated
+        ranks — reference: dndarray.py:355-382)."""
+        return self.shard_halos(self.__comm.rank)[0]
 
     @property
     def halo_next(self):
-        return None
+        return self.shard_halos(self.__comm.rank)[1]
 
     @property
     def array_with_halos(self) -> jax.Array:
-        """Local data with attached halos (reference: dndarray.py:355-362).
-        No eager halo ever exists here, so this is the logical array."""
-        return self.larray
+        """Local data with attached halos (reference: dndarray.py:355-362
+        ``__cat_halo``): the calling rank's logical shard with whatever
+        halos :meth:`get_halo` fetched concatenated along the split axis."""
+        return self.shard_with_halos(self.__comm.rank)
+
+    def shard_with_halos(self, rank: int) -> jax.Array:
+        """One shard's logical data with its halos concatenated (the
+        single-controller face of :attr:`array_with_halos`)."""
+        if self.__split is None:
+            return self.larray
+        _, lshape, slices = self.__comm.chunk(self.__gshape, self.__split, rank=rank)
+        local = self.larray[slices]
+        prev, nxt = self.shard_halos(rank)
+        parts = [p for p in (prev, local, nxt) if p is not None]
+        return jnp.concatenate(parts, axis=self.__split)
 
     @property
     def lloc(self) -> "_LlocAccessor":
@@ -626,7 +685,28 @@ class DNDarray:
                     adv_hits_split = True
                 in_dim += 1
         if adv_hits_split:
-            return self.__split if only_split_1d else None
+            if only_split_1d:
+                return self.__split
+            if any(is_bool_arr(k) for k in key):
+                # boolean masks give data-dependent output extents, which
+                # GSPMD cannot shard statically — replicated by design
+                return None
+            # the broadcast advanced block consumed the split dim: the
+            # result stays DISTRIBUTED, sharded over the block's first
+            # output dim (round 3; the reference keeps such gathers
+            # distributed with unbalanced output, dndarray.py:779-1035 —
+            # here the canonical even-chunk layout plays that role)
+            lo, hi = min(block_positions), max(block_positions)
+            contiguous = all(p in block_positions for p in range(lo, hi + 1))
+            if not contiguous:
+                return 0  # NumPy pushes the block to the front
+            out_pos = 0
+            for pos, k in enumerate(key):
+                if pos == lo:
+                    break
+                if k is None or isinstance(k, slice):
+                    out_pos += 1
+            return out_pos
 
         # split dim survives as a sliced dim; find its output position
         lo, hi = min(block_positions), max(block_positions)

@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.collectives import shard_map
 
-__all__ = ["halo_exchange", "map_with_halos"]
+__all__ = ["exchange_halos", "halo_exchange", "map_with_halos"]
 
 
 def halo_exchange(
@@ -121,3 +121,45 @@ def map_with_halos(
         shard_fn, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
     )(x.parray)
     return DNDarray(out, x.gshape, types.heat_type_of(out), split, x.device, x.comm)
+
+
+def _build_exchange(mesh, axis_name, spec, split, halo_size):
+    def shard_fn(local):
+        moved = jnp.moveaxis(local, split, 0) if split != 0 else local
+        prev_h, next_h = halo_exchange(moved, halo_size, axis_name, axis=0)
+        return prev_h, next_h
+
+    return shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec,),
+        out_specs=(P(axis_name), P(axis_name)), check_vma=False,
+    )
+
+
+def exchange_halos(x, halo_size: int):
+    """Materialize every shard's halo slabs with ONE compiled exchange
+    (the data-facing face of the exchange, backing ``DNDarray.get_halo``
+    — reference: dndarray.py:383-453, where each rank posts Isend/Irecv
+    pairs and caches the result; here both directions are a pair of
+    ``collective_permute`` ops over the whole mesh at once).
+
+    Returns ``(prev_all, next_all)``: jax arrays of shape
+    ``(n_shards * halo_size, *rest)`` sharded along axis 0 — shard r's
+    slabs live at rows ``[r*halo_size, (r+1)*halo_size)``, with the sort
+    axis moved to the front.  Global-edge shards hold zeros; the caller
+    applies the reference's populated-rank masking.
+    """
+    from ..core.dndarray import DNDarray
+    from ..parallel.collectives import jit_shard_map_cached
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"exchange_halos expects a DNDarray, got {type(x)}")
+    comm = x.comm
+    split = x.split
+    # cached build+jit: a fresh closure per call would recompile the
+    # exchange on every get_halo (the per-call-recompile incident class,
+    # docs/PERFORMANCE.md design rules)
+    fn = jit_shard_map_cached(
+        _build_exchange, comm.mesh, comm.split_axis,
+        comm.spec(split, x.ndim), split, halo_size,
+    )
+    return fn(x.parray)

@@ -172,9 +172,17 @@ class TestCompatSurface(TestCase):
         self.assertEqual(int(sp.lloc[3]), 3)
         sp.lloc[0] = 99
         self.assertEqual(int(sp.numpy()[0]), 99)
-        self.assertEqual(sp.array_with_halos.shape, (13,))
-        self.assertIsNone(sp.halo_prev)
+        # array_with_halos is the RANK's shard view (reference: local
+        # tensor + any fetched halos; round 3 wired the real exchange —
+        # see tests/test_halo.py for the full semantics)
+        self.assertEqual(
+            sp.array_with_halos.shape, tuple(sp.lshape_map[0])
+        )
+        self.assertIsNone(sp.halo_prev)   # nothing fetched yet
         self.assertIsNone(sp.halo_next)
+        sp.get_halo(1)
+        self.assertIsNone(sp.halo_prev)   # rank 0 is the first populated
+        self.assertIsNotNone(sp.halo_next)
         self.assertEqual(sp.cpu().numpy().shape, (13,))
         for name in ("exp2", "expm1", "log", "log2", "log10", "log1p",
                      "sqrt", "square", "conj", "copy", "nonzero",
