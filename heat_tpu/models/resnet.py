@@ -17,9 +17,37 @@ from typing import Any, Callable, Sequence, Tuple
 import jax.numpy as jnp
 import flax.linen as nn
 
-__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152"]
+__all__ = [
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "space_to_depth",
+]
 
 ModuleDef = Any
+
+
+def space_to_depth(x, block: int = 2):
+    """Fold ``block x block`` spatial patches into channels:
+    (B, H, W, C) -> (B, H/b, W/b, b*b*C), rows-major within the patch.
+
+    The ResNet stem's 7x7/stride-2 conv reads 3-channel pixels — a
+    3-lane minor dim the TPU pads to 128 (docs/PERFORMANCE.md lane-pad
+    rule) and a convolution XLA cannot tile efficiently.  Transforming
+    the IMAGE once (in the input pipeline, where it's a reshape of bytes
+    already being copied) lets the stem be a dense 4x4/stride-1 conv over
+    12 channels in block space — the MLPerf-style space-to-depth stem,
+    whose function space contains the original stem's (4x4 taps of 2x2
+    pixel blocks cover 8x8 >= 7x7 pixels)."""
+    b, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(f"spatial dims {(h, w)} not divisible by {block}")
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
 
 
 class BasicBlock(nn.Module):
@@ -80,6 +108,9 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.float32
     act: Callable = nn.relu
+    # expect :func:`space_to_depth`-transformed input (B, H/2, W/2, 12)
+    # and use the block-space 4x4/stride-1 stem (see space_to_depth)
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -96,7 +127,16 @@ class ResNet(nn.Module):
             # ~20% of the step (profiled on v5e, bf16 batch 128)
             dtype=self.dtype,
         )
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.s2d_stem:
+            # block-space equivalent of 7x7/s2 with padding 3: the taps
+            # cover pixel rows 2y-3..2y+3 ⊂ blocks y-2..y+1 → kernel 4,
+            # stride 1, padding (2, 1)
+            x = conv(
+                self.num_filters, (4, 4), (1, 1),
+                padding=[(2, 1), (2, 1)], name="conv_init",
+            )(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

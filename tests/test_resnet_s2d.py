@@ -1,0 +1,78 @@
+"""Space-to-depth stem (round 3, VERDICT weak #3's named lever): the
+block-space 4x4/stride-1 stem's function space must CONTAIN the 7x7/s2
+pixel stem — verified by expressing an arbitrary 7x7 kernel as a 4x4
+block kernel and comparing the convolutions exactly."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import heat_tpu as ht
+from heat_tpu.models.resnet import space_to_depth
+from .base import TestCase
+
+
+class TestSpaceToDepth(TestCase):
+    def test_transform_layout(self):
+        x = np.arange(2 * 4 * 4 * 3, dtype=np.float32).reshape(2, 4, 4, 3)
+        y = np.asarray(space_to_depth(jnp.asarray(x)))
+        self.assertEqual(y.shape, (2, 2, 2, 12))
+        # channel layout: (pr, pc, c) row-major within each 2x2 patch
+        np.testing.assert_array_equal(y[0, 0, 0, 0:3], x[0, 0, 0])
+        np.testing.assert_array_equal(y[0, 0, 0, 3:6], x[0, 0, 1])
+        np.testing.assert_array_equal(y[0, 0, 0, 6:9], x[0, 1, 0])
+        np.testing.assert_array_equal(y[0, 0, 0, 9:12], x[0, 1, 1])
+
+    def test_indivisible_raises(self):
+        with self.assertRaises(ValueError):
+            space_to_depth(jnp.zeros((1, 5, 4, 3)))
+
+    def test_stem_function_space_contains_7x7s2(self):
+        rng = np.random.default_rng(0)
+        img = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+        w7 = rng.standard_normal((7, 7, 3, 5)).astype(np.float32)
+
+        ref = lax.conv_general_dilated(
+            jnp.asarray(img), jnp.asarray(w7), window_strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+        # express w7 as a block-space (4, 4, 12, 5) kernel:
+        # w4[kbr, kbc, (pr*2+pc)*3+c] = w7[dr+3, dc+3, c],
+        # dr = 2*kbr - 4 + pr, dc = 2*kbc - 4 + pc
+        w4 = np.zeros((4, 4, 12, 5), np.float32)
+        for kbr in range(4):
+            for kbc in range(4):
+                for pr in range(2):
+                    for pc in range(2):
+                        dr = 2 * kbr - 4 + pr
+                        dc = 2 * kbc - 4 + pc
+                        if -3 <= dr <= 3 and -3 <= dc <= 3:
+                            w4[kbr, kbc, (pr * 2 + pc) * 3 : (pr * 2 + pc) * 3 + 3] = w7[
+                                dr + 3, dc + 3
+                            ]
+        got = lax.conv_general_dilated(
+            space_to_depth(jnp.asarray(img)), jnp.asarray(w4),
+            window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        self.assertEqual(got.shape, ref.shape)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
+
+    def test_model_runs_with_s2d_stem(self):
+        import optax
+
+        model = ht.models.ResNet50(num_classes=10, s2d_stem=True)
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((8, 32, 32, 3)).astype(np.float32)
+        Xs = space_to_depth(jnp.asarray(X))
+        dp = ht.nn.DataParallel(
+            model, optimizer=ht.optim.DataParallelOptimizer(optax.sgd(0.1))
+        )
+        dp.init(0, np.asarray(Xs))
+        y = np.zeros(8, np.int64)
+        loss = dp.train_step(ht.array(np.asarray(Xs), split=0), ht.array(y, split=0))
+        self.assertTrue(np.isfinite(float(loss)))
