@@ -36,15 +36,52 @@ def drain_all(*xs) -> float:
     serializes dispatch)."""
     return float(np.asarray(_first_scalar_sum(list(xs))))
 
+# ------------------------------------------------------------- chain-delta
+# Every derived rate in this suite comes from a chain-delta SLOPE, not a
+# single timed call: time k1 units, time k2 units, divide the difference by
+# (k2 - k1).  The fixed cost of the final drain readback — ~130-250 ms of
+# tunnel round trip on the remote TPU, the thing that made the round-2
+# artifact contradict docs/PERFORMANCE.md by 5-15x on short kernels —
+# appears in both timings and cancels.  k2 is found adaptively: double the
+# chain length until the measured delta dwarfs the round-trip jitter.
+# bench.py pioneered the recipe; this is the same method for the whole
+# suite.
+
+# the delta must dwarf the ~100 ms tunnel jitter on TPU; CPU has no tunnel
+MIN_DELTA_S = 0.4 if ON_TPU else 0.05
+SLOPE_TRIALS = 3
+MAX_CHAIN = 1025
+
+
+from heat_tpu.utils.bench import Slope, chain_slope  # noqa: E402
+
+
+def slope(run_k, k1: int = 1, min_delta: float = None, trials: int = None) -> Slope:
+    """Platform-defaulted wrapper over the shared chain-delta helper
+    (heat_tpu/utils/bench.py): on TPU the delta must dwarf the ~100 ms
+    tunnel jitter."""
+    return chain_slope(
+        run_k,
+        k1=k1,
+        min_delta=MIN_DELTA_S if min_delta is None else min_delta,
+        trials=SLOPE_TRIALS if trials is None else trials,
+        max_k=MAX_CHAIN,
+    )
+
+
 MATMUL_N = 8192 if ON_TPU else 1500
-# short kernels chain several iterations inside the monitored region so the
-# measured span dwarfs the remote-tunnel round trip (bench.py's recipe)
-MATMUL_ITERS = 20 if ON_TPU else 2
-ATTN_ITERS = 10 if ON_TPU else 2
-MOE_ITERS = 10 if ON_TPU else 2
 QR_N = 2048 if ON_TPU else 512
 TSQR_M, TSQR_N = (1_000_000, 128) if ON_TPU else (20_000, 64)
 CLUSTER_N = 250_000 if ON_TPU else 5_000
+# Lloyd-iteration throughput at the docs/PERFORMANCE.md headline config
+# (2e7x64 f32, k=8) — the basis of the derived kmeans_samples_per_s, which
+# round 2 computed from a whole toy fit and got 3500x under the headline
+LLOYD_N, LLOYD_F, LLOYD_K = (20_000_000, 64, 8) if ON_TPU else (20_000, 8, 8)
+# the BASELINE.md KMeans north-star: 1e8x64 bf16 split=0 on ONE chip —
+# only reachable via pack-at-ingest (cluster.packing) + the blocked loop
+NORTHSTAR_N, NORTHSTAR_F, NORTHSTAR_K = (
+    (100_000_000, 64, 8) if ON_TPU else (30_000, 64, 8)
+)
 RESHAPE_SIZES = [10_000, 20_000, 40_000] if ON_TPU else [1_000, 2_000]
 CONCAT_N = 1_000_000 if ON_TPU else 50_000
 ATTN_BH, ATTN_S, ATTN_D = (16, 4096, 128) if ON_TPU else (4, 256, 32)
@@ -52,5 +89,4 @@ MOE_T, MOE_D, MOE_H = (16_384, 1024, 4096) if ON_TPU else (512, 64, 128)
 # 5e5x1e3 f32: the fit holds x, its unit-norm copy and intermediates — ~8 GB
 # peak of a 16 GB v5e; 1e6 rows would OOM during the normalization
 LASSO_M, LASSO_N = (500_000, 1_000) if ON_TPU else (2_000, 32)
-LASSO_ITERS = 10
-RESNET_BATCH, RESNET_IMG, RESNET_STEPS = (256, 224, 4) if ON_TPU else (8, 32, 2)
+RESNET_BATCH, RESNET_IMG = (256, 224) if ON_TPU else (8, 32)

@@ -1,93 +1,125 @@
 # Continuous-benchmark linalg workloads (reference: benchmarks/cb/linalg.py:
 # matmul n=3000 split 0/1, qr n=2000 tiles 1-2 split 0/1, lanczos n=50 f64).
 #
-# Data is generated in run() and every kernel is warmed (compiled) before
-# the monitored call, so the monitored region times the kernel — not host
-# RNG, transfer, or XLA compilation.
+# Every rate is a chain-delta slope (config.slope): the workload runs as a
+# dependent chain of k identical units ending in one drain readback, timed
+# at two chain lengths, so the fixed tunnel round trip cancels.  Each
+# recorded wall_s is seconds PER UNIT (one matmul, one qr, ...).
 
 import heat_tpu as ht
-from heat_tpu.utils.monitor import monitor
+from heat_tpu.utils.monitor import record
 
 import config
 
 
-def _mm(a, b):
-    # chained square matmuls: one dependent chain, so the final readback
-    # (monitor's drain) forces every link; values may overflow — the
-    # timing is unaffected and derive() divides by the chain length
-    c = a
-    for _ in range(config.MATMUL_ITERS):
-        c = c @ b
-    return c.larray
+def _mm_chain(a, b):
+    # dependent chain: each link's output feeds the next, so the final
+    # readback forces every link; values may overflow — timing only
+    def run_k(k):
+        c = a
+        for _ in range(k):
+            c = c @ b
+        config.drain(c.larray)
+    return run_k
 
 
-def _qr_q(a):
-    return ht.linalg.qr(a).Q.larray
+def _qr_chain(a):
+    # dependent chain: Q is shape-preserving and well-conditioned, so
+    # qr(Q) repeats the same FLOPs; square input takes the Householder
+    # path — no per-call sync to pollute the slope
+    def run_k(k):
+        c = a
+        for _ in range(k):
+            c = ht.linalg.qr(c).Q
+        config.drain(c.larray)
+    return run_k
 
 
-def _tsqr_r(a):
-    return ht.linalg.qr(a).R.larray
+def _tsqr_kernel_chain(arr):
+    # the CholeskyQR2 KERNEL (linalg/qr.py:_cholesky_qr2): the public
+    # qr() adds one deliberate host sync per call (breakdown check,
+    # qr.py:144-152) that a tunnel turns into a full round trip per link,
+    # which no chain can cancel — so the throughput number times the
+    # kernel, and tsqr_user_call records the synchronous surface cost
+    # separately
+    from heat_tpu.core.linalg.qr import _cholesky_qr2
+
+    def run_k(k):
+        c = arr
+        for _ in range(k):
+            c, _ = _cholesky_qr2(c, calc_q=True)
+        config.drain(c)
+    return run_k
 
 
-def _lanczos(B, m):
-    V, T = ht.lanczos(B, m=m)
-    return V.larray
-
-
-@monitor()
-def matmul_split_0(a, b):
-    return config.drain(_mm(a, b))
-
-
-@monitor()
-def matmul_split_1(a, b):
-    return config.drain(_mm(a, b))
-
-
-@monitor()
-def qr(mats):
-    return config.drain_all(*[_qr_q(a) for a in mats])
-
-
-@monitor()
-def tsqr_tall_skinny(a):
-    return config.drain(_tsqr_r(a))
-
-
-@monitor()
-def lanczos(B, m):
-    return config.drain(_lanczos(B, m))
+def _lanczos_chain(B, m):
+    def run_k(k):
+        out = None
+        for _ in range(k):
+            V, _T = ht.lanczos(B, m=m)
+            out = V
+        config.drain(out.larray)
+    return run_k
 
 
 def run():
     n = config.MATMUL_N
-    a0 = ht.random.random((n, n), split=0)
-    b0 = ht.random.random((n, n), split=0)
-    config.drain(_mm(a0, b0))  # warmup: compile (incl. the drain readback)
-    matmul_split_0(a0, b0)
-
-    a1 = ht.random.random((n, n), split=1)
-    b1 = ht.random.random((n, n), split=1)
-    config.drain(_mm(a1, b1))
-    matmul_split_1(a1, b1)
-    del a0, b0, a1, b1
+    for sp in (0, 1):
+        a = ht.random.random((n, n), split=sp)
+        b = ht.random.random((n, n), split=sp)
+        run_k = _mm_chain(a, b)
+        run_k(1)  # warmup: compile (incl. the drain readback)
+        sl = config.slope(run_k)
+        record(
+            f"matmul_split_{sp}", sl.per_unit_s, per="matmul",
+            **sl.fields(),
+        )
+        del a, b
 
     qn = config.QR_N
-    mats = [ht.random.random((qn, qn), split=sp) for sp in range(2)]
-    config.drain_all(*[_qr_q(m_) for m_ in mats])  # warmup
-    qr(mats)
-    del mats
+    for sp in (0, 1):
+        a = ht.random.random((qn, qn), split=sp)
+        run_k = _qr_chain(a)
+        run_k(1)
+        sl = config.slope(run_k)
+        record(
+            f"qr_split_{sp}", sl.per_unit_s, per="qr",
+            **sl.fields(),
+        )
+        del a
 
     ts = ht.random.random((config.TSQR_M, config.TSQR_N), split=0)
-    config.drain(_tsqr_r(ts))
-    tsqr_tall_skinny(ts)
+    run_k = _tsqr_kernel_chain(ts.larray)
+    run_k(1)
+    sl = config.slope(run_k)
+    record(
+        "tsqr_tall_skinny", sl.per_unit_s, per="cholesky_qr2",
+        surface="kernel", **sl.fields(),
+    )
+    # the public surface: one call, including its deliberate breakdown-
+    # check sync (one tunnel round trip here; ~free on a colocated host)
+    import time as _time
+
+    config.drain(ht.linalg.qr(ts).R.larray)  # warmup
+    t0 = _time.perf_counter()
+    config.drain(ht.linalg.qr(ts).R.larray)
+    record(
+        "tsqr_user_call", _time.perf_counter() - t0, per="qr-call",
+        method="single-run",
+        note="includes one host sync (qr.py breakdown check)",
+    )
     del ts
 
     ln = 50
     A = ht.random.random((ln, ln), dtype=ht.float64, split=0)
     B = A @ A.T
-    config.drain(_lanczos(B, ln))
-    lanczos(B, ln)
+    run_k = _lanczos_chain(B, ln)
+    run_k(1)
+    sl = config.slope(run_k)
+    record(
+        "lanczos", sl.per_unit_s, per="lanczos-m50",
+        **sl.fields(),
+    )
 
 
 if __name__ == "__main__":

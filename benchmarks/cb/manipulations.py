@@ -1,45 +1,79 @@
 # Continuous-benchmark manipulation workloads (reference: benchmarks/cb/
 # manipulations.py: reshape with new_split; plus the concatenate/resplit
 # cases from the CI suite, SURVEY.md §6).
+#
+# Each workload repeats k rounds of identical work ending in one drain, and
+# records the chain-delta slope — seconds per ROUND — so the fixed tunnel
+# round trip cancels (round 2 recorded 1.86 s for three small reshapes;
+# that was the readback, not the reshapes).
 
 import heat_tpu as ht
-from heat_tpu.utils.monitor import monitor
+from heat_tpu.utils.monitor import record
 
 import config
 
 
-def _reshape(sizes):
-    outs = []
-    for size in sizes:
-        st = ht.zeros((1000, size), split=1)
-        outs.append(ht.reshape(st, (st.size // 10, -1), new_split=1).larray)
-    return config.drain_all(*outs)
+def _reshape_chain(sizes):
+    # inputs are created ONCE: creating the arrays inside the chain made
+    # round 2's number a measurement of array construction (a host
+    # buffer upload through the tunnel), not of reshape
+    srcs = [ht.random.random((1000, size), split=1) for size in sizes]
+
+    def run_k(k):
+        outs = []
+        for _ in range(k):
+            outs = [
+                ht.reshape(st, (st.size // 10, -1), new_split=1).larray
+                for st in srcs
+            ]
+        config.drain_all(*outs)
+    return run_k
 
 
-@monitor()
-def reshape(sizes=config.RESHAPE_SIZES):
-    return _reshape(sizes)
+def _concat_chain(a, b):
+    def run_k(k):
+        out = None
+        for _ in range(k):
+            out = ht.concatenate([a, b], axis=0).larray
+        config.drain(out)
+    return run_k
 
 
-@monitor()
-def concatenate(a, b):
-    return config.drain(ht.concatenate([a, b], axis=0).larray)
-
-
-@monitor()
-def resplit(a):
-    return config.drain(ht.resplit(a, 1).larray)
+def _resplit_chain(a):
+    def run_k(k):
+        out = None
+        for _ in range(k):
+            out = ht.resplit(a, 1).larray
+        config.drain(out)
+    return run_k
 
 
 def run():
-    _reshape(config.RESHAPE_SIZES)  # warmup
-    reshape()
+    run_k = _reshape_chain(config.RESHAPE_SIZES)
+    run_k(1)  # warmup: compile
+    sl = config.slope(run_k)
+    record(
+        "reshape", sl.per_unit_s, per=f"{len(config.RESHAPE_SIZES)}-reshapes",
+        **sl.fields(),
+    )
+
     a = ht.random.random((config.CONCAT_N, 64), split=0)
     b = ht.random.random((config.CONCAT_N, 64), split=0)
-    config.drain(ht.concatenate([a, b], axis=0).larray)
-    concatenate(a, b)
-    config.drain(ht.resplit(a, 1).larray)
-    resplit(a)
+    run_k = _concat_chain(a, b)
+    run_k(1)
+    sl = config.slope(run_k)
+    record(
+        "concatenate", sl.per_unit_s, per="concatenate",
+        **sl.fields(),
+    )
+
+    run_k = _resplit_chain(a)
+    run_k(1)
+    sl = config.slope(run_k)
+    record(
+        "resplit", sl.per_unit_s, per="resplit",
+        **sl.fields(),
+    )
 
 
 if __name__ == "__main__":

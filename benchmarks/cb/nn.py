@@ -2,59 +2,45 @@
 # reference's cb suite has no attention or MoE; these cover the kernels this
 # framework adds: flash attention and the expert-parallel MoE FFN).
 #
-# Data is generated in run() and each kernel is warmed (compiled) before the
-# monitored call, so the monitored region times the kernel — not host RNG,
-# transfer, or XLA compilation (the cluster.py pattern, plus warmup).
+# Attention and MoE chain k dependent passes inside ONE jitted fori_loop
+# whose trip count is a traced argument (no recompiles as k varies), so the
+# chain-delta slope (config.slope) times the kernel alone — round 2's
+# single-drain pattern recorded the ~250 ms tunnel round trip as if it were
+# kernel time (attention 14.3 ms/pass recorded vs 0.94 measured).
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from heat_tpu.utils.monitor import monitor
+from heat_tpu.utils.monitor import record
 
 import config
 
 
-def _attention_step(q):
+@jax.jit
+def _attn_chain(q, n):
     from heat_tpu.ops.attention import flash_attention
 
-    out = q
-    for _ in range(config.ATTN_ITERS):
-        out = flash_attention(out, out, out, causal=True)
-    return out
+    return lax.fori_loop(
+        0, n, lambda i, v: flash_attention(v, v, v, causal=True), q
+    )
 
 
 @jax.jit
-def _moe_step(x, gate, w_in, w_out):
+def _moe_chain(x, gate, w_in, w_out, n):
     from heat_tpu.parallel.expert import moe_ffn
 
-    y = x
-    for _ in range(config.MOE_ITERS):
-        y, _ = moe_ffn(y, gate, w_in, w_out, k=2)
-    return y
-
-
-@monitor()
-def flash_attention_forward(q):
-    return config.drain(_attention_step(q))
-
-
-@monitor()
-def moe_ffn_forward(x, gate, w_in, w_out):
-    return config.drain(_moe_step(x, gate, w_in, w_out))
-
-
-@monitor()
-def resnet50_dp_steps(model, X, y, steps):
-    loss = None
-    for _ in range(steps):
-        loss = model.train_step(X, y)
-    return config.drain(loss)
+    return lax.fori_loop(
+        0, n, lambda i, v: moe_ffn(v, gate, w_in, w_out, k=2)[0], x
+    )
 
 
 def _resnet_bench():
     # the BASELINE.md DP flagship: ResNet-50 train step, batch sharded over
-    # the mesh, grad all-reduce implicit in the jitted step
+    # the mesh, grad all-reduce implicit in the jitted step.  train_step
+    # returns a device scalar (no per-step sync), so a python loop of k
+    # steps ending in one drain is a clean chain.
     import optax
 
     import heat_tpu as ht
@@ -71,26 +57,82 @@ def _resnet_bench():
     model.init(0, Xh[: min(b, 8)])
     X = ht.array(Xh, split=0)
     y = ht.array(yh, split=0)
-    config.drain(model.train_step(X, y))  # warmup: compile (incl. drain)
-    resnet50_dp_steps(model, X, y, config.RESNET_STEPS)
+
+    def run_k(k):
+        loss = None
+        for _ in range(k):
+            loss = model.train_step(X, y)
+        config.drain(loss)
+
+    run_k(1)  # warmup: compile (incl. drain)
+    sl = config.slope(run_k)
+    record(
+        "resnet50_dp_step", sl.per_unit_s, per="train-step",
+        batch=b, image=img, **sl.fields(),
+    )
+    del model, X
+
+    # space-to-depth stem variant (round 3): the 7x7/s2 3-channel stem
+    # becomes a 4x4/s1 conv over 12 channels in block space — the input
+    # transform happens once in the pipeline (models/resnet.py)
+    from heat_tpu.models.resnet import space_to_depth
+
+    Xs = np.asarray(space_to_depth(jnp.asarray(Xh)))
+    model2 = ht.nn.DataParallel(
+        ht.models.ResNet50(num_classes=1000, dtype=dt, s2d_stem=True),
+        optimizer=ht.optim.DataParallelOptimizer(optax.sgd(0.1)),
+    )
+    model2.init(0, Xs[: min(b, 8)])
+    X2 = ht.array(Xs, split=0)
+
+    def run_k2(k):
+        loss = None
+        for _ in range(k):
+            loss = model2.train_step(X2, y)
+        config.drain(loss)
+
+    run_k2(1)
+    sl = config.slope(run_k2)
+    record(
+        "resnet50_s2d_dp_step", sl.per_unit_s, per="train-step",
+        batch=b, image=img, stem="space-to-depth", **sl.fields(),
+    )
 
 
 def run():
     rng = np.random.default_rng(0)
     dt = jnp.bfloat16 if config.ON_TPU else jnp.float32
 
-    bh, s, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
-    q = jnp.asarray(rng.standard_normal((bh, s, d)), dt)
-    config.drain(_attention_step(q))  # warmup: compile
-    flash_attention_forward(q)
+    bh, s_, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
+    q = jnp.asarray(rng.standard_normal((bh, s_, d)), dt)
+
+    def attn_k(k):
+        config.drain(_attn_chain(q, jnp.int32(k)))
+
+    attn_k(1)  # warmup: compile once (trip count is traced)
+    sl = config.slope(attn_k)
+    record(
+        "flash_attention_forward", sl.per_unit_s, per="attention-pass",
+        causal=True, **sl.fields(),
+    )
+    del q
 
     t, dm, h = config.MOE_T, config.MOE_D, config.MOE_H
     x = jnp.asarray(rng.standard_normal((t, dm)), dt)
     gate = jnp.asarray(rng.standard_normal((dm, 8)), dt)
     w_in = jnp.asarray(rng.standard_normal((8, dm, h)) / 32, dt)
     w_out = jnp.asarray(rng.standard_normal((8, h, dm)) / 32, dt)
-    config.drain(_moe_step(x, gate, w_in, w_out))  # warmup: compile
-    moe_ffn_forward(x, gate, w_in, w_out)
+
+    def moe_k(k):
+        config.drain(_moe_chain(x, gate, w_in, w_out, jnp.int32(k)))
+
+    moe_k(1)
+    sl = config.slope(moe_k)
+    record(
+        "moe_ffn_forward", sl.per_unit_s, per="moe-pass",
+        **sl.fields(),
+    )
+    del x, gate, w_in, w_out
 
     _resnet_bench()
 

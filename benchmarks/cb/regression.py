@@ -1,23 +1,16 @@
 # Continuous-benchmark regression workload (reference: benchmarks/2020/lasso
 # configs; BASELINE.md's Lasso row: synthetic design matrix, split=0).
+#
+# Records seconds per full coordinate-descent sweep as a chain-delta slope
+# over max_iter (tol=-1 disables the early exit; max_iter is traced, so no
+# recompiles), cancelling the estimator's fixed host readbacks and the
+# tunnel round trip.
 import numpy as np
 
 import heat_tpu as ht
-from heat_tpu.utils.monitor import monitor
+from heat_tpu.utils.monitor import record
 
 import config
-
-
-def _fit(x, y):
-    est = ht.regression.Lasso(lam=0.01, max_iter=config.LASSO_ITERS)
-    est.fit(x, y)
-    config.drain(est.coef_.larray)
-    return est
-
-
-@monitor()
-def lasso_fit(x, y):
-    return _fit(x, y)
 
 
 def run():
@@ -29,13 +22,18 @@ def run():
     beta = np.zeros((n, 1), np.float32)
     beta[:: max(n // 16, 1)] = 2.0
     y = ht.matmul(x, ht.array(beta)) + 0.01 * ht.random.randn(m, 1, split=0)
-    _fit(x, y)  # warmup: compile the coordinate-descent loop
-    est = lasso_fit(x, y)
-    # the loop early-exits on tol: record the sweeps that actually ran so
-    # derive() credits real work (rows/s was inflated otherwise)
-    from heat_tpu.utils.monitor import annotate_last
 
-    annotate_last(n_iter=int(est.n_iter))
+    def run_k(k):
+        est = ht.regression.Lasso(lam=0.01, max_iter=k, tol=-1.0)
+        est.fit(x, y)
+        config.drain(est.coef_.larray)
+
+    run_k(1)  # warmup: compile the coordinate-descent loop
+    sl = config.slope(run_k, k1=2)
+    record(
+        "lasso_sweep", sl.per_unit_s, per="cd-sweep",
+        m=m, n=n, **sl.fields(),
+    )
 
 
 if __name__ == "__main__":

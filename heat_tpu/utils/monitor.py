@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
-__all__ = ["monitor", "measurements", "report", "reset", "profile_trace"]
+__all__ = ["monitor", "measurements", "record", "report", "reset", "profile_trace"]
 
 _MEASUREMENTS: List[Dict[str, Any]] = []
 
@@ -75,6 +75,21 @@ def monitor(name: Optional[str] = None, emit: bool = True) -> Callable:
         return wrapper
 
     return deco
+
+
+def record(name: str, wall_s: float, emit: bool = True, **fields) -> None:
+    """Record a measurement whose timing was computed externally — e.g. a
+    chain-delta slope where the harness timed two rep counts and took the
+    difference so a fixed readback/tunnel cost cancels (bench.py's method).
+    ``fields`` should say how (method=, k1=, k2=, ...) so the artifact is
+    self-describing."""
+    entry = {"name": name, "wall_s": round(float(wall_s), 6), **fields}
+    mem = _device_memory()
+    if mem is not None:
+        entry["device_bytes_in_use"] = mem
+    _MEASUREMENTS.append(entry)
+    if emit:
+        print(json.dumps(entry), file=sys.stderr)
 
 
 def measurements() -> List[Dict[str, Any]]:
