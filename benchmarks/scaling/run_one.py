@@ -1,0 +1,101 @@
+# One mesh-size leg of the weak/strong scaling sweep (reference:
+# benchmarks/2020/*/config.json — nodes x {strong: fixed size, weak: size
+# proportional to nodes}).  Run by main.py as a SUBPROCESS: the virtual
+# device count is fixed per process (XLA_FLAGS is read at jax import), so
+# each mesh size needs its own interpreter.
+#
+# Workloads mirror the reference's 2020 suite: kmeans, distance_matrix
+# (cdist), lasso, statistical_moments.  Timing is a chain-delta slope
+# (benchmarks/cb/config.py rationale) even though the virtual CPU mesh has
+# no tunnel — it also cancels dispatch overhead.
+import argparse
+import json
+
+import numpy as np
+
+
+def slope(run_k, k1=1):
+    # shared chain-delta helper; imported lazily so jax (pulled in by the
+    # heat_tpu package) initializes only after main() pins the platform
+    from heat_tpu.utils.bench import chain_slope
+
+    return chain_slope(run_k, k1=k1, min_delta=0.25, max_k=257).per_unit_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, required=True)
+    ap.add_argument("--mode", choices=("weak", "strong"), required=True)
+    ap.add_argument("--base-n", type=int, default=200_000)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == args.devices, (
+        f"mesh has {len(jax.devices())} devices, wanted {args.devices} — "
+        "set XLA_FLAGS=--xla_force_host_platform_device_count"
+    )
+
+    import heat_tpu as ht
+
+    n = args.base_n * (args.devices if args.mode == "weak" else 1)
+    f = 32
+    results = {}
+
+    # kmeans (reference: 2020/kmeans): slope over Lloyd iterations
+    data = ht.random.randn(n, f, split=0)
+
+    def km_k(k):
+        est = ht.cluster.KMeans(n_clusters=8, init="random", max_iter=k,
+                                tol=-1.0, random_state=3)
+        est.fit(data)
+        float(ht.sum(est.cluster_centers_ * 0.0))
+
+    km_k(1)
+    results["kmeans_iter_s"] = slope(km_k, k1=2)
+
+    # distance matrix (reference: 2020/distance_matrix): n x 512 cdist
+    Y = ht.random.randn(512, f, split=None)
+
+    def cd_k(k):
+        # drain EVERY unit: queueing many collective programs deadlocks
+        # XLA CPU's in-process rendezvous (observed 2-device all-reduce
+        # aborts at queue depth >~10); the per-unit sync is host-side
+        # microseconds against ms-scale units and identical at k1/k2
+        for _ in range(k):
+            float(ht.sum(ht.spatial.cdist(data, Y) * 0.0))
+
+    cd_k(1)
+    results["cdist_call_s"] = slope(cd_k)
+
+    # lasso (reference: 2020/lasso): slope over coordinate sweeps
+    xs = data
+    beta = np.zeros((f, 1), np.float32)
+    beta[::4] = 1.5
+    y = ht.matmul(xs, ht.array(beta))
+
+    def la_k(k):
+        est = ht.regression.Lasso(lam=0.01, max_iter=k, tol=-1.0)
+        est.fit(xs, y)
+        float(ht.sum(est.coef_ * 0.0))
+
+    la_k(1)
+    results["lasso_sweep_s"] = slope(la_k, k1=2)
+
+    # statistical moments (reference: 2020/statistical_moments)
+    def mo_k(k):
+        for _ in range(k):  # drain per unit — see cd_k
+            float(ht.sum((ht.var(data, axis=0) + ht.mean(data, axis=0)) * 0.0))
+
+    mo_k(1)
+    results["moments_call_s"] = slope(mo_k)
+
+    print(json.dumps({
+        "devices": args.devices, "mode": args.mode, "n": n, "f": f,
+        "results": {k: round(v, 6) for k, v in results.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()
