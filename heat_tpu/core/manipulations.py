@@ -545,10 +545,13 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     gather + re-unique there). Result is replicated: its size is data-
     dependent.
 
-    A split 1-D input goes through the distributed merge-split sort
-    (parallel/sort.py) first, then per-shard compaction: the host only ever
-    holds one sorted shard slab plus the uniques themselves — never the full
-    data axis (the reference's local-unique-then-gather memory profile).
+    A split 1-D input goes through the distributed sort (parallel/sort.py)
+    first, then ON-DEVICE per-shard dedup + compaction (one ppermute
+    carries each left neighbor's last element for the boundary compare —
+    round 3; the previous host loop pulled every sorted slab to numpy,
+    O(n) tunnel traffic per call).  The host reads the tiny per-shard
+    counts and then transfers exactly the uniques, one compacted slab
+    prefix at a time — never the full data axis.
     """
     sanitation.sanitize_in(a)
     if (
@@ -558,29 +561,26 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         and a.comm.size > 1
         and a.is_distributed()
     ):
+        from ..parallel.sort import unique_compact_sorted
+
         sv, _ = sort(a, axis=0)
         phys = sv.parray
         n = a.shape[0]
-        per = phys.shape[0] // a.comm.size
+        compacted, counts = unique_compact_sorted(
+            phys, a.comm.mesh, a.comm.split_axis, n
+        )
+        counts_host = np.asarray(counts)
         from .dndarray import _split_axis_shards
 
-        shards = _split_axis_shards(phys, 0)
-        parts, prev_last = [], None
-        is_float = np.issubdtype(np.dtype(a.dtype.jax_type()), np.floating)
+        shards = _split_axis_shards(compacted, 0)
+        parts = []
         for r, sh in enumerate(shards):
-            valid = builtins.min(builtins.max(n - r * per, 0), per)
-            if valid == 0:
-                break
-            slab = np.unique(np.asarray(sh.data)[:valid])
-            if prev_last is not None and slab.size:
-                dup = slab[0] == prev_last or (
-                    is_float and np.isnan(slab[0]) and np.isnan(prev_last)
-                )
-                if dup:
-                    slab = slab[1:]
-            if slab.size:
-                parts.append(slab)
-                prev_last = slab[-1]
+            c = int(counts_host[r])
+            if c:
+                # slice ON DEVICE before the transfer: np.asarray of the
+                # whole slab would move the full padded buffer to host —
+                # the O(n) traffic this path exists to avoid
+                parts.append(np.asarray(sh.data[:c]))
         np_dtype = np.dtype(a.dtype.jax_type())
         uni = np.concatenate(parts) if parts else np.empty(0, dtype=np_dtype)
         vals = jnp.asarray(uni)
