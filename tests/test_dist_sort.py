@@ -664,3 +664,51 @@ class TestUniqueOnDeviceCompaction(TestCase):
         text = jax.jit(fn).lower(keys).compile().as_text()
         self.assertNotIn("all-gather", text)
         self.assertNotIn("all-to-all", text)
+
+
+class TestColumnsortOddSubmeshes(TestCase):
+    """Columnsort on 6- and 7-device submeshes: odd shard counts exercise
+    the unpaired-shard branches of the cleanup rounds, and 7 does not
+    divide typical sizes — per-shard padding plus the internal per_pad
+    extension and compaction all engage."""
+
+    def _check_on_submesh(self, S, n):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from heat_tpu.parallel.mesh import MeshComm
+        from heat_tpu.parallel.sort import distributed_sort
+
+        devs = np.asarray(jax.devices()[:S])
+        comm = MeshComm(Mesh(devs, ("x",)), split_axis="x")
+        rng = np.random.default_rng(S * 1000 + n)
+        A = rng.integers(0, 9, n).astype(np.int32)
+        per = -(-n // S)
+        phys = np.zeros(per * S, A.dtype)
+        phys[:n] = A
+        x = jax.device_put(jnp.asarray(phys), comm.sharding(0, 1))
+        v, i = distributed_sort(x, comm.mesh, "x", 0, n, method="columnsort")
+        v = np.asarray(v)[:n]
+        i = np.asarray(i)[:n]
+        np.testing.assert_array_equal(v, np.sort(A, kind="stable"))
+        np.testing.assert_array_equal(i, np.argsort(A, kind="stable"))
+
+    def test_six_devices(self):
+        for n in (301, 600, 1201):
+            self._check_on_submesh(6, n)
+
+    def test_seven_devices(self):
+        for n in (505, 1001, 1400):
+            self._check_on_submesh(7, n)
+
+    def test_float16_keys(self):
+        # f16 exercises the 16-bit total-order bit key in descending sorts
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal(1600).astype(np.float16)
+        v, _ = ht.sort(ht.array(A, split=0))
+        np.testing.assert_array_equal(v.numpy(), np.sort(A))
+        vd, idd = ht.sort(ht.array(A, split=0), descending=True)
+        vl, idl = ht.sort(ht.array(A), descending=True)
+        np.testing.assert_array_equal(vd.numpy(), vl.numpy())
+        np.testing.assert_array_equal(idd.numpy(), idl.numpy())
