@@ -174,31 +174,32 @@ def _lloyd_loop_packed_blocked_impl(x2, centers, k: int, p: int, n: int, blk: in
         cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
 
         def body(i, carry):
-            sums, counts, part = carry
+            sums, counts = carry
             # dynamic_slice clamps the start: the last block re-reads
             # earlier rows, so mask rows below this block's true start
             start = jnp.minimum(i * blk, rows - blk)
             xb = jax.lax.dynamic_slice_in_dim(x2, start, blk, 0)
-            # pin the block: without the barrier XLA commutes the sums
-            # GEMM's row-contraction layout wish through the dynamic
-            # slice and hoists a FULL copy of x2 out of the loop
-            # (verified both ways: removing this line re-creates the
-            # 11.9 GB HLO temp)
-            xb = jax.lax.optimization_barrier(xb)
+            # NO optimization barrier here: with the slimmed body the
+            # layout solver keeps the payload's natural orientation and
+            # fuses the slice into its consumers (compile-reported temps
+            # 0.02 GB); the earlier fuller body needed a barrier to stop
+            # a transpose-hoist of the whole payload — re-probe if ops
+            # are added back
             gsl = (start * p) + jnp.arange(blk * p)
             vb = ((gsl < n) & (gsl >= i * blk * p)).astype(jnp.float32)
             vb = vb.reshape(blk, p)
-            x3 = xb.reshape(blk, p, f)
-            sqb = jnp.sum(x3.astype(jnp.float32) ** 2, axis=2)
+            # m2[j] = |c_j|^2 - 2<x, c_j> has the same argmin as d^2: the
+            # per-sample |x|^2 shifts every cluster equally, so neither
+            # the labels nor the convergence check need it — the profiled
+            # per-iteration |x|^2 pass (convert+square+reduce, ~59 ms of
+            # a 169 ms iteration at n=1e8) is gone; fit computes the
+            # final inertia once in the labels pass
             cross = jax.lax.dot_general(
                 xb, w, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ).reshape(blk, p, k)
-            d2 = jnp.maximum(
-                cn2[None, None, :] - 2.0 * cross + sqb[..., None], 0.0
-            )
-            labels = jnp.argmin(d2, axis=2)
-            part = part + jnp.sum(jnp.min(d2, axis=2) * vb)
+            m2 = cn2[None, None, :] - 2.0 * cross
+            labels = jnp.argmin(m2, axis=2)
             oh = (labels[..., None] == jnp.arange(k)[None, None, :]).astype(
                 x2.dtype
             ) * vb[..., None].astype(x2.dtype)
@@ -220,19 +221,20 @@ def _lloyd_loop_packed_blocked_impl(x2, centers, k: int, p: int, n: int, blk: in
                 sums = sums + jax.lax.dynamic_slice(
                     all_sums, (s * k, s * f), (k, f)
                 )
-            return sums, counts, part
+            return sums, counts
 
-        sums, counts, part = jax.lax.fori_loop(
+        sums, counts = jax.lax.fori_loop(
             0,
             nb,
             body,
             (
                 jnp.zeros((k, f), jnp.float32),
                 jnp.zeros((k,), jnp.float32),
-                jnp.array(0.0, jnp.float32),
             ),
         )
-        inertia = part
+        # the loop reports inertia 0: its true value is only needed once,
+        # after convergence — _fit_packed computes it in the labels pass
+        inertia = jnp.array(0.0, jnp.float32)
         new_centers = jnp.where(
             counts[:, None] > 0,
             sums / jnp.maximum(counts, 1)[:, None],
@@ -508,16 +510,39 @@ class KMeans(_KCluster):
             types.canonical_heat_type(centers.dtype), None, packed.device,
             packed.comm,
         )
-        self._labels = self._predict_packed(packed)
+        # BOTH packed branches take inertia from the final labels pass —
+        # distance to the FINAL centers (sklearn's inertia_ definition),
+        # identical on either side of the blocked-path size threshold.
+        # (The dense path keeps the reference's definition: the last
+        # iteration's assignment distances, pre-update centers.)
+        del inertia
+        self._labels, inertia = self._predict_packed_with_inertia(packed)
         self._inertia = float(inertia)
         return self
+
+    def _predict_packed_with_inertia(self, packed):
+        x2 = packed.x2.parray
+        # half-size blocks: the labels pass carries the flat label buffer
+        # (0.4 GB at 1e8) plus per-block temps, and the full _BLOCK_ROWS
+        # puts its compile-reported peak within ~300 MB of the ceiling
+        labels, inertia = _packed_labels_blocked(
+            x2, self._cluster_centers.larray, packed.p, packed.n,
+            min(x2.shape[0], _BLOCK_ROWS // 2), with_inertia=True,
+        )
+        out = DNDarray(
+            labels, tuple(labels.shape),
+            types.canonical_heat_type(labels.dtype), packed.split,
+            packed.device, packed.comm,
+        )
+        return out, inertia
 
     def _predict_packed(self, packed) -> DNDarray:
         x2 = packed.x2.parray
         if _use_blocked(x2):
-            labels = _packed_labels_blocked(
+            # labels only: skip the inertia |x|^2 sweep
+            labels, _ = _packed_labels_blocked(
                 x2, self._cluster_centers.larray, packed.p, packed.n,
-                min(x2.shape[0], _BLOCK_ROWS),
+                min(x2.shape[0], _BLOCK_ROWS), with_inertia=False,
             )
         else:
             labels = _packed_labels(
@@ -557,9 +582,12 @@ def _use_blocked(x2) -> bool:
     return single and x2.size * x2.dtype.itemsize > _BLOCKED_BYTES
 
 
-def _packed_labels_blocked_impl(x2, centers, p: int, n: int, blk: int):
-    """Blocked nearest-centroid labels (see _lloyd_loop_packed_blocked —
-    the whole-array cross term cannot exist next to the payload).
+def _packed_labels_blocked_impl(x2, centers, p: int, n: int, blk: int, with_inertia: bool = True):
+    """Blocked nearest-centroid labels AND the total inertia (see
+    _lloyd_loop_packed_blocked — the whole-array cross term cannot exist
+    next to the payload; and inertia is only needed once, after
+    convergence, so the per-sample |x|^2 lives here rather than in every
+    Lloyd iteration).
 
     The label buffer is FLAT (rows*p,): a (rows, p) int32 array lane-pads
     p -> 128 under the TPU's T(8,128) tiling — 64x, a 25.6 GB buffer for
@@ -574,32 +602,50 @@ def _packed_labels_blocked_impl(x2, centers, p: int, n: int, blk: int):
         w = jax.lax.dynamic_update_slice(w, cT, (s * f, s * k))
     cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
 
-    def body(i, out):
+    def body(i, carry):
+        out, inertia = carry
         start = jnp.minimum(i * blk, rows - blk)
         xb = jax.lax.dynamic_slice_in_dim(x2, start, blk, 0)
+        gsl = (start * p) + jnp.arange(blk * p)
+        vbf = ((gsl < n) & (gsl >= i * blk * p)).astype(jnp.float32)
         cross = jax.lax.dot_general(
             xb, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ).reshape(blk, p, k)
-        lb = jnp.argmin(cn2[None, None, :] - 2.0 * cross, axis=2).astype(jnp.int32)
+        m2 = cn2[None, None, :] - 2.0 * cross
+        lb = jnp.argmin(m2, axis=2).astype(jnp.int32)
+        if with_inertia:
+            sqb = jnp.sum(
+                xb.reshape(blk * p, f).astype(jnp.float32) ** 2, axis=1
+            )
+            # d2 = |x|^2 + min m2, clamped at 0 per sample (f32 rounding
+            # near centroids can dip negative)
+            d2min = jnp.maximum(sqb + jnp.min(m2, axis=2).reshape(-1), 0.0)
+            inertia = inertia + jnp.sum(d2min * vbf)
         # overlap from the clamped tail start rewrites identical values
-        return jax.lax.dynamic_update_slice(out, lb.reshape(-1), (start * p,))
+        out = jax.lax.dynamic_update_slice(out, lb.reshape(-1), (start * p,))
+        return out, inertia
 
-    labels = jax.lax.fori_loop(
-        0, nb, body, jnp.zeros((rows * p,), jnp.int32)
+    labels, inertia = jax.lax.fori_loop(
+        0, nb, body,
+        (jnp.zeros((rows * p,), jnp.int32), jnp.array(0.0, jnp.float32)),
     )
-    return labels[:n]
+    return labels[:n], inertia
 
 
 @lru_cache(maxsize=None)
-def _labels_blocked_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format):
+def _labels_blocked_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format, with_inertia):
     """AOT labels pass baking in the payload's actual format (same
-    relayout-copy avoidance as :func:`_blocked_loop_compiled`)."""
+    relayout-copy avoidance as :func:`_blocked_loop_compiled`).  The
+    inertia sweep (an extra per-block |x|^2 pass) compiles in only when
+    asked — predict wants labels alone."""
     from jax.experimental.layout import Format, Layout
 
     dt = jnp.dtype(dtype_str)
 
     def fn(x2, centers):
-        return _packed_labels_blocked_impl(x2, centers, p, n, blk)
+        return _packed_labels_blocked_impl(
+            x2, centers, p, n, blk, with_inertia
+        )
 
     jitted = jax.jit(fn, in_shardings=(x2_format, Format(Layout.AUTO)))
     return jitted.lower(
@@ -608,10 +654,12 @@ def _labels_blocked_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format):
     ).compile()
 
 
-def _packed_labels_blocked(x2, centers, p, n, blk):
+def _packed_labels_blocked(x2, centers, p, n, blk, with_inertia=True):
+    """Returns ``(labels (n,), inertia scalar)`` — inertia is 0 when
+    ``with_inertia`` is off (labels-only predict path)."""
     comp = _labels_blocked_compiled(
         x2.shape[0], x2.shape[1], str(x2.dtype), int(centers.shape[0]),
-        int(p), int(n), int(blk), x2.format,
+        int(p), int(n), int(blk), x2.format, bool(with_inertia),
     )
     fmts = comp.input_formats[0]
     centers = jax.device_put(jnp.asarray(centers, x2.dtype), fmts[1])

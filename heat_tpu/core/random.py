@@ -87,7 +87,7 @@ def set_state(state: Tuple) -> None:
 _CHUNK_F32_BYTES = 2 << 30  # chunk when the f32 intermediate would top 2 GB
 
 
-def _chunk_sampler(sampler, shape, jdtype, block_layout=None):
+def _chunk_sampler(sampler, shape, jdtype):
     """Wrap ``sampler`` to generate big sub-f32 arrays in row blocks.
 
     jax.random's samplers compute through a float32 intermediate before the
@@ -95,12 +95,6 @@ def _chunk_sampler(sampler, shape, jdtype, block_layout=None):
     own size in HBM and OOMs a 16 GB chip even though the result fits.  Row
     blocks via fori_loop keep the f32 intermediate per-block (the block key
     is fold_in(key, block) — deterministic per shape, mesh-size invariant).
-
-    ``block_layout`` pins each block's layout before the update-slice: when
-    the OUTPUT layout is pinned away from what the sampler naturally emits,
-    the relayout must happen per block — left to itself XLA appends one
-    whole-array relayout copy instead (2x the array transiently, the OOM
-    the chunking exists to avoid).
     """
     import math
 
@@ -113,23 +107,13 @@ def _chunk_sampler(sampler, shape, jdtype, block_layout=None):
     rows = -(-shape[0] // n_chunks)
     n_full, rem = divmod(shape[0], rows)
 
-    def constrain(blk):
-        if block_layout is None:
-            return blk
-        try:
-            from jax.experimental.layout import with_layout_constraint
-
-            return with_layout_constraint(blk, block_layout)
-        except Exception:  # pragma: no cover - older layout API
-            return blk
-
     def chunked(key, _shape, _dtype):
         tail = tuple(shape[1:])
         zeros = (0,) * len(tail)
 
         def body(i, out):
             kb = jax.random.fold_in(key, i)
-            blk = constrain(sampler(kb, (rows,) + tail, _dtype))
+            blk = sampler(kb, (rows,) + tail, _dtype)
             return jax.lax.dynamic_update_slice(out, blk, (i * rows,) + zeros)
 
         # the output buffer is allocated at the EXACT final shape and updated
@@ -139,7 +123,7 @@ def _chunk_sampler(sampler, shape, jdtype, block_layout=None):
         out = jax.lax.fori_loop(0, n_full, body, out)
         if rem:
             kb = jax.random.fold_in(key, n_full)
-            blk = constrain(sampler(kb, (rem,) + tail, _dtype))
+            blk = sampler(kb, (rem,) + tail, _dtype)
             out = jax.lax.dynamic_update_slice(out, blk, (n_full * rows,) + zeros)
         return out
 
@@ -166,17 +150,13 @@ def _sharded_sample(shape, split, device, comm, sampler, jdtype, upcast=False) -
             # per block under _chunk_sampler: no array-sized f32 intermediate
             return _base(k, s, jnp.float32).astype(d)
 
-    pinned_layout = None
-    if len(shape) >= 2:
-        try:
-            from jax.experimental.layout import Layout
-
-            pinned_layout = Layout(
-                major_to_minor=tuple(reversed(range(len(shape))))
-            )
-        except Exception:  # pragma: no cover - older layout API
-            pinned_layout = None
-    chunked = _chunk_sampler(sampler, shape, jdtype, block_layout=pinned_layout)
+    # NOTE on layouts: the chunked program naturally emits jax-(0, 1)
+    # (row-major) output, which is ALSO what the blocked KMeans consumers'
+    # layout solvers prefer after the round-3 slim-down — no pin needed.
+    # (An earlier revision pinned the opposite orientation for the fuller
+    # loop body; consumers bake the payload's actual format, so the
+    # at-rest layout and the solver preference only need to agree.)
+    chunked = _chunk_sampler(sampler, shape, jdtype)
     if chunked is not None:
         sampler = chunked
     split_ = split if len(shape) else None
@@ -189,22 +169,6 @@ def _sharded_sample(shape, split, device, comm, sampler, jdtype, upcast=False) -
     else:
         sharding = comm.sharding(split_, len(shape))
         out = sharding
-        if chunked is not None and pinned_layout is not None:
-            # pin the output layout: left free, XLA lays the chunked
-            # fori/update-slice program's output out as (0, 1) — the
-            # OPPOSITE of what it chooses for the big consumers of these
-            # arrays (probed: the packed Lloyd loop's AUTO-layout solve
-            # picks (1, 0) for the payload), so every consumer would pay
-            # a full-array relayout copy — 12.8 GB and an OOM at the
-            # 1e8x64 bf16 north-star size.  Generate in the consumer
-            # orientation instead; the block-level constraint above keeps
-            # the relayout per block.
-            try:
-                from jax.experimental.layout import Format
-
-                out = Format(pinned_layout, sharding)
-            except Exception:  # pragma: no cover - older layout API
-                pass
         fn = jax.jit(lambda k: sampler(k, shape, jdtype), out_shardings=out)
         garray = fn(key)
     return DNDarray(

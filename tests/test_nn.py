@@ -403,9 +403,11 @@ class TestNNReviewRegressions(TestCase):
         self.assertIn("[0, 1, 2]", r.stdout, r.stderr)
 
 
-class TestDASOFourSliceUneven(TestCase):
-    """VERDICT r2 weak #6: grow the virtual-mesh DASO evidence — a 4-slice
-    (dcn=4, ici=2) schedule, and the uneven-slice rejection path."""
+class TestDASOMultiSlice(TestCase):
+    """VERDICT r2 weak #6: grow the virtual-mesh DASO evidence — 4-slice
+    (dcn=4, ici=2) and 8x1 schedules.  (Uneven slice sizes are not
+    representable: a jax Mesh is rectangular by construction, so every
+    dcn slice owns the same ici extent.)"""
 
     def _mesh(self, dcn, ici):
         import jax
