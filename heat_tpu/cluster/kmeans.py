@@ -84,8 +84,9 @@ def _lloyd_step(x, centers, k: int):
     return new_centers, shift, inertia
 
 
-@partial(jax.jit, static_argnames=("k", "p"))
-def _lloyd_loop_packed(x2, sq, valid, centers, k: int, p: int, max_iter, tol):
+@partial(jax.jit, static_argnames=("k", "p", "with_inertia"))
+def _lloyd_loop_packed(x2, sq, valid, centers, k: int, p: int, max_iter, tol,
+                       with_inertia: bool = True):
     """Lloyd loop over lane-packed data.
 
     Sub-128-lane bf16 rows read f32-sized HBM on this chip (layout
@@ -112,19 +113,21 @@ def _lloyd_loop_packed(x2, sq, valid, centers, k: int, p: int, max_iter, tol):
             x2, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
-        # all slots at once: (n/p, p, k) distances, slot-major one-hots;
-        # clamp like ops_cdist does — f32 rounding across the three terms
-        # can go slightly negative at/near centroids, and a negative
-        # minimum would leak into the reported inertia
-        d2 = jnp.maximum(
-            sq[:, :, None] + cn2[None, None, :] - 2.0 * cross.reshape(-1, p, k),
-            0.0,
-        )
-        labels = jnp.argmin(d2, axis=2)  # (n/p, p)
+        # all slots at once: (n/p, p, k) distances, slot-major one-hots.
+        # |x|^2 shifts every cluster equally, so the argmin only needs
+        # m2 = |c|^2 - 2<x,c>; the full d2 (clamped at 0 like ops_cdist —
+        # f32 rounding near centroids can dip negative) is built only
+        # when the caller wants the per-iteration inertia
+        m2 = cn2[None, None, :] - 2.0 * cross.reshape(-1, p, k)
+        labels = jnp.argmin(m2, axis=2)  # (n/p, p)
         vf = valid[..., None].astype(x2.dtype)
         oh = (labels[..., None] == jnp.arange(k)[None, None, :]).astype(x2.dtype) * vf
         counts = jnp.sum(oh, axis=(0, 1), dtype=jnp.float32)
-        inertia = jnp.sum(jnp.min(d2, axis=2) * valid)
+        if with_inertia:
+            d2min = jnp.maximum(sq + jnp.min(m2, axis=2), 0.0)
+            inertia = jnp.sum(d2min * valid)
+        else:
+            inertia = jnp.array(0.0, jnp.float32)
         # ONE masked-sum matmul for every slot: a per-slot dot would read
         # x2 p times and hand the traffic win straight back
         all_sums = jax.lax.dot_general(
@@ -250,11 +253,12 @@ def _lloyd_loop_packed_blocked_impl(x2, centers, k: int, p: int, n: int, blk: in
 def _blocked_loop_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format):
     """AOT-compile the blocked loop, baking in the payload's ACTUAL
     format (see the impl docstring for why the default pinned layouts
-    OOM; the generation side pins the at-rest layout to the orientation
-    the layout solver picks for this loop, so no copy appears).  Any
-    layout the payload does not already have — whether jit's default or
-    a free AUTO choice that happens to differ — costs a full-array
-    relayout: 12.8 GB and the OOM at the north-star size."""
+    OOM).  The slim loop body's layout solve prefers the payload's
+    natural (generation-time) orientation, so no relayout copy appears;
+    any layout the payload does not already have — whether jit's default
+    or a free AUTO choice that happens to differ — costs a full-array
+    relayout: 12.8 GB and the OOM at the north-star size.  Re-probe
+    memory_analysis() both ways whenever the body changes."""
     from jax.experimental.layout import Format, Layout
 
     dt = jnp.dtype(dtype_str)
@@ -499,10 +503,18 @@ class KMeans(_KCluster):
                 self.max_iter, self.tol,
             )
         else:
-            sq, valid = _packed_stats(x2, packed.p, packed.n)
+            # validity mask only — the per-slot |x|^2 pass would be dead
+            # work here (with_inertia=False; inertia comes from the final
+            # labels pass)
+            rows = x2.shape[0]
+            valid = (
+                jnp.arange(rows * packed.p).reshape(rows, packed.p)
+                < packed.n
+            ).astype(jnp.float32)
             centers, _, inertia, n_iter = _lloyd_loop_packed(
-                x2, sq, valid, centers, self.n_clusters, packed.p,
-                self.max_iter, self.tol,
+                x2, jnp.zeros((1, 1), jnp.float32), valid, centers,
+                self.n_clusters, packed.p, self.max_iter, self.tol,
+                with_inertia=False,
             )
         self._n_iter = int(n_iter)
         self._cluster_centers = DNDarray(
@@ -516,43 +528,36 @@ class KMeans(_KCluster):
         # (The dense path keeps the reference's definition: the last
         # iteration's assignment distances, pre-update centers.)
         del inertia
-        self._labels, inertia = self._predict_packed_with_inertia(packed)
+        self._labels, inertia = self._predict_packed(packed, with_inertia=True)
         self._inertia = float(inertia)
         return self
 
-    def _predict_packed_with_inertia(self, packed):
+    def _predict_packed(self, packed, with_inertia: bool = False):
+        """Labels (and optionally inertia) from packed data.  The blocked
+        single-chip path engages only under the same _use_blocked guard
+        as the fit loop; mesh-sharded payloads keep the GSPMD-friendly
+        whole-array matmul."""
         x2 = packed.x2.parray
-        # half-size blocks: the labels pass carries the flat label buffer
-        # (0.4 GB at 1e8) plus per-block temps, and the full _BLOCK_ROWS
-        # puts its compile-reported peak within ~300 MB of the ceiling
-        labels, inertia = _packed_labels_blocked(
-            x2, self._cluster_centers.larray, packed.p, packed.n,
-            min(x2.shape[0], _BLOCK_ROWS // 2), with_inertia=True,
-        )
+        if _use_blocked(x2):
+            # half-size blocks when the inertia sweep rides along: it
+            # adds per-block |x|^2 temps, and full _BLOCK_ROWS puts the
+            # compile-reported peak within ~300 MB of the ceiling
+            blk = _BLOCK_ROWS // 2 if with_inertia else _BLOCK_ROWS
+            labels, inertia = _packed_labels_blocked(
+                x2, self._cluster_centers.larray, packed.p, packed.n,
+                min(x2.shape[0], blk), with_inertia=with_inertia,
+            )
+        else:
+            labels, inertia = _packed_labels(
+                x2, self._cluster_centers.larray, packed.p, packed.n,
+                with_inertia=with_inertia,
+            )
         out = DNDarray(
             labels, tuple(labels.shape),
             types.canonical_heat_type(labels.dtype), packed.split,
             packed.device, packed.comm,
         )
-        return out, inertia
-
-    def _predict_packed(self, packed) -> DNDarray:
-        x2 = packed.x2.parray
-        if _use_blocked(x2):
-            # labels only: skip the inertia |x|^2 sweep
-            labels, _ = _packed_labels_blocked(
-                x2, self._cluster_centers.larray, packed.p, packed.n,
-                min(x2.shape[0], _BLOCK_ROWS), with_inertia=False,
-            )
-        else:
-            labels = _packed_labels(
-                x2, self._cluster_centers.larray, packed.p, packed.n
-            )
-        return DNDarray(
-            labels, tuple(labels.shape),
-            types.canonical_heat_type(labels.dtype), packed.split,
-            packed.device, packed.comm,
-        )
+        return (out, inertia) if with_inertia else out
 
     def predict(self, x) -> DNDarray:
         from .packing import PackedSamples
@@ -733,11 +738,12 @@ def _packed_stats(x2, p: int, n: int):
     return sq, valid
 
 
-@partial(jax.jit, static_argnames=("p", "n"))
-def _packed_labels(x2, centers, p: int, n: int):
-    """(n, 1) nearest-centroid labels from packed data: one block-diagonal
-    cross matmul (the packed Lloyd step's distance math, re-used for the
-    final assignment pass)."""
+@partial(jax.jit, static_argnames=("p", "n", "with_inertia"))
+def _packed_labels(x2, centers, p: int, n: int, with_inertia: bool = False):
+    """Nearest-centroid labels (flat (n,)) from packed data — one
+    block-diagonal cross matmul, GSPMD-friendly for mesh-sharded
+    payloads — plus the total inertia when asked (distance to these
+    centers, sklearn's inertia_ definition)."""
     rows, pf = x2.shape
     f = pf // p
     k = centers.shape[0]
@@ -749,7 +755,18 @@ def _packed_labels(x2, centers, p: int, n: int):
         x2, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     ).reshape(rows, p, k)
     cn2 = jnp.sum(centers.astype(jnp.float32) ** 2, axis=1)
-    labels = jnp.argmin(cn2[None, None, :] - 2.0 * cross, axis=2)
+    m2 = cn2[None, None, :] - 2.0 * cross
+    labels = jnp.argmin(m2, axis=2)
+    if with_inertia:
+        f = pf // p
+        sq = jnp.sum(
+            x2.reshape(rows * p, f).astype(jnp.float32) ** 2, axis=1
+        )
+        valid = (jnp.arange(rows * p) < n).astype(jnp.float32)
+        d2min = jnp.maximum(sq + jnp.min(m2, axis=2).reshape(-1), 0.0)
+        inertia = jnp.sum(d2min * valid)
+    else:
+        inertia = jnp.array(0.0, jnp.float32)
     # flat (n,) labels: a trailing length-1/length-p dim lane-pads to 128
     # under TPU tiling (see _packed_labels_blocked_impl)
-    return labels.reshape(-1)[:n].astype(jnp.int32)
+    return labels.reshape(-1)[:n].astype(jnp.int32), inertia

@@ -298,6 +298,6 @@ class TestPackedIngest(TestCase):
         X = rng.standard_normal((n, f)).astype(np.float32)
         ps = ht.cluster.pack(ht.array(X, split=0, dtype=ht.bfloat16))
         centers = jnp.asarray(X[:5], jnp.bfloat16)
-        la = np.asarray(_packed_labels(ps.x2.larray, centers, p, n))
+        la = np.asarray(_packed_labels(ps.x2.larray, centers, p, n)[0])
         lb, _inertia = _packed_labels_blocked(ps.x2.larray, centers, p, n, 50)
         np.testing.assert_array_equal(la.ravel(), np.asarray(lb).ravel())
