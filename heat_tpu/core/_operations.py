@@ -83,6 +83,11 @@ def _binary_op(
         b = t2.larray if isinstance(t2, DNDarray) else t2
         if isinstance(b, (list, tuple, np.ndarray)):
             b = jnp.asarray(b)
+        if np.isscalar(b):
+            # scalar-aware promotion (reference: result_type, types.py:868
+            # — a python scalar must not widen the array dtype): jax's
+            # weak-type rules under x64 would take int32 + 1.5 to f64
+            b = jnp.asarray(b, types.result_type(t1.dtype, b).jax_type())
         s1, nd1 = t1.split, t1.ndim
         s2, nd2 = None, (np.ndim(b) if not np.isscalar(b) else 0)
         out_shape = broadcast_shape(t1.shape, np.shape(b))
@@ -91,6 +96,8 @@ def _binary_op(
         a = t1
         if isinstance(a, (list, tuple, np.ndarray)):
             a = jnp.asarray(a)
+        if np.isscalar(a):
+            a = jnp.asarray(a, types.result_type(t2.dtype, a).jax_type())
         s2, nd2 = t2.split, t2.ndim
         s1, nd1 = None, (np.ndim(a) if not np.isscalar(a) else 0)
         out_shape = broadcast_shape(np.shape(a), t2.shape)
