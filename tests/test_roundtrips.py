@@ -89,6 +89,12 @@ class TestPromotionChains(TestCase):
         self.assertIs((x + 1).dtype, ht.int32)
         self.assertIs((x + 1.5).dtype, ht.float32)
         self.assertIs((x > 2).dtype, ht.bool)
+        # scalar as the FIRST operand takes the same branch
+        self.assertIs(ht.add(1.5, x).dtype, ht.float32)
+        self.assertIs(ht.subtract(1, x).dtype, ht.int32)
+        np.testing.assert_array_equal(
+            ht.subtract(1, x).numpy(), 1 - np.arange(5)
+        )
 
     def test_bf16_f32_promotes_f32(self):
         a = ht.array(np.ones(6, np.float32), split=0, dtype=ht.bfloat16)
