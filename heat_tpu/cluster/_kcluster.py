@@ -171,6 +171,10 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             ht_random.seed(self.random_state)
         k = self.n_clusters
         n = x.shape[0]
+        if n < k:
+            raise ValueError(
+                f"n_samples={n} should be >= n_clusters={k}"
+            )
         arr = x.larray
 
         if isinstance(self.init, DNDarray):

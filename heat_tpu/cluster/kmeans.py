@@ -471,6 +471,10 @@ class KMeans(_KCluster):
             ht_random.seed(self.random_state)
         k = self.n_clusters
         n, f, p = packed.n, packed.f, packed.p
+        if n < k:
+            raise ValueError(
+                f"n_samples={n} should be >= n_clusters={k}"
+            )
         x2 = packed.x2.parray
 
         if isinstance(self.init, DNDarray):

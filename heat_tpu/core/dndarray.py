@@ -176,6 +176,13 @@ class DNDarray:
     @larray.setter
     def larray(self, array: jax.Array):
         self.__array = array
+        self._invalidate_halos()
+
+    def _invalidate_halos(self) -> None:
+        """Drop cached halo slabs; they are only valid until the next mutation
+        of the data or the split axis (the reference's halo state has the same
+        lifetime — it is refetched per ``get_halo`` call)."""
+        self.__halos = None
 
     @property
     def parray(self) -> jax.Array:
@@ -331,6 +338,7 @@ class DNDarray:
         if not copy:
             self.__array = casted
             self.__dtype = types.canonical_heat_type(casted.dtype)
+            self._invalidate_halos()
             return self
         return DNDarray(
             casted,
@@ -404,6 +412,7 @@ class DNDarray:
         self.__array = _to_physical(self.larray, self.__gshape, axis, self.__comm)
         self.__split = axis
         self.__lshape_map = None
+        self._invalidate_halos()
         return self
 
     def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
@@ -560,6 +569,7 @@ class DNDarray:
         eye = jnp.eye(self.shape[0], self.shape[1], dtype=bool)
         new = jnp.where(eye, jnp.asarray(value, arr.dtype), arr)
         self.__array = _to_physical(new, self.__gshape, self.__split, self.__comm)
+        self._invalidate_halos()
         return self
 
     # ---------------------------------------------------------------- helpers
@@ -752,6 +762,7 @@ class DNDarray:
             value = value.larray
         new = self.larray.at[jkey].set(value)
         self.__array = _to_physical(new, self.__gshape, self.__split, self.__comm)
+        self._invalidate_halos()
 
     def __len__(self) -> int:
         if self.ndim == 0:

@@ -63,6 +63,22 @@ class TestKClustering(TestCase):
                 found.add(dominant)
             self.assertEqual(len(found), 4)
 
+    def test_kmeans_fewer_samples_than_clusters_raises(self):
+        # round-4 ADVICE fix: n < k would otherwise draw every initial
+        # centroid from sample 0 (n // k == 0 strata), on both paths
+        data = ht.array(np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32))
+        with self.assertRaises(ValueError):
+            ht.cluster.KMeans(n_clusters=5, init="random").fit(data)
+        from heat_tpu.cluster.packing import pack
+
+        packed = pack(
+            ht.array(
+                np.random.default_rng(1).standard_normal((3, 4)), dtype=ht.bfloat16
+            )
+        )
+        with self.assertRaises(ValueError):
+            ht.cluster.KMeans(n_clusters=5, init="random").fit(packed)
+
     def test_kmeans_predict_inertia(self):
         data = spherical_data(32)
         km = ht.cluster.KMeans(n_clusters=4, random_state=1).fit(data)

@@ -110,6 +110,36 @@ class TestGetHalo(TestCase):
         self.assertIsNone(x.halo_prev)
         np.testing.assert_allclose(np.asarray(x.array_with_halos), np.ones((6, 2)))
 
+    def test_halo_cache_invalidated_on_mutation(self):
+        """Cached halos must not survive __setitem__, the larray setter, or
+        resplit_ (round-4 ADVICE fix): stale slabs would return pre-mutation
+        data, and post-resplit they'd be read against the wrong axis."""
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal((16, 2)).astype(np.float32)
+        x = ht.array(A, split=0)
+        x.get_halo(1)
+        self.assertIsNotNone(x.halo_next)
+        # __setitem__ must drop the cache; refetched halos see the new data
+        x[0:4] = 7.0
+        self.assertIsNone(x.halo_next)
+        x.get_halo(1)
+        prev, _ = x.shard_halos(1)
+        np.testing.assert_allclose(np.asarray(prev), [[7.0, 7.0]])
+        # in-place astype and fill_diagonal also mutate the data
+        x.astype(ht.int32, copy=False)
+        self.assertIsNone(x.halo_next)
+        x.get_halo(1)
+        x.fill_diagonal(0)
+        self.assertIsNone(x.halo_next)
+        # larray setter must drop the cache
+        x.larray = x.larray * 0.0
+        self.assertIsNone(x.halo_prev)
+        self.assertIsNone(x.halo_next)
+        # resplit_ must drop the cache (split axis changed)
+        x.get_halo(1)
+        x.resplit_(1)
+        self.assertIsNone(x.halo_next)
+
     def test_halo_data_is_computable(self):
         """Halos as DATA (the reference's reason for the API): a manual
         boundary stencil from the halo buffers matches the global one."""
