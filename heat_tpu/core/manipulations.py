@@ -590,9 +590,25 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         )
         if return_inverse:
             inverse = jnp.searchsorted(vals, a.larray)
+            if np.issubdtype(np_dtype, np.floating):
+                # NaN queries: make the mapping to the collapsed NaN slot
+                # explicit instead of leaning on searchsorted's NaN-last
+                # total order (reference parity: numpy maps every NaN input
+                # to the single NaN in the uniques)
+                nan_slots = np.nonzero(np.isnan(uni))[0]
+                if nan_slots.size:
+                    inverse = jnp.where(
+                        jnp.isnan(a.larray), jnp.asarray(int(nan_slots[0]), inverse.dtype), inverse
+                    )
+            # the inverse is elementwise-indexed like the input: keep it
+            # sharded the same way (was replicated pre-round-4 — an n-sized
+            # replicated buffer for a split input)
+            from .dndarray import _to_physical
+
             inv = DNDarray(
-                inverse, tuple(inverse.shape),
-                types.canonical_heat_type(inverse.dtype), None, a.device, a.comm,
+                _to_physical(inverse, tuple(inverse.shape), a.split, a.comm),
+                tuple(inverse.shape),
+                types.canonical_heat_type(inverse.dtype), a.split, a.device, a.comm,
             )
             return v, inv
         return v
