@@ -1,0 +1,129 @@
+"""Distributed boolean-mask selection along the split axis.
+
+The reference keeps ``x[mask]`` distributed with unbalanced output: each
+rank selects from its local shard and the result's lshape map is whatever
+the mask left behind (heat/core/dndarray.py:779-1035).  GSPMD arrays hold
+the canonical even-chunk layout instead, so the TPU-native design is a
+*compact-and-rebalance* program (round 4, closing the last indexing path
+that replicated):
+
+1. **count** — one tiny host readback of ``mask.sum()`` fixes the output
+   extent ``n_sel`` (XLA needs static shapes; the reference pays the same
+   sync in its Allgather of local counts).
+2. **shard-local compact** — each shard keeps its selected elements,
+   front-compacted by a stable argsort (the ``unique_compact_sorted``
+   pattern, parallel/sort.py:445).
+3. **count exchange** — an ``all_gather`` of ONE int32 per shard gives
+   every shard its exclusive prefix, hence each selected element's global
+   destination position.
+4. **rebalance** — each shard scatters its survivors into a zero buffer at
+   their global destinations and ONE ``psum_scatter`` (reduce-scatter over
+   ICI) hands every shard exactly its canonical output slab.
+
+The input is never gathered: per-shard peak memory is one output-sized
+send buffer (``n_sel``-sized — the thing being *produced*), never the
+input-sized replicated intermediate the eager path materialized.  Wire
+traffic is one reduce-scatter of the output volume plus S scalars.
+
+``flatten=True`` serves the full-``ndim`` mask form ``x[m]`` with
+``m.shape == x.shape`` (row-major flattened output): with split=0 the
+global row-major flatten is shard-contiguous, so the same program runs on
+the per-shard flattened slabs.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .collectives import shard_map_unchecked
+
+__all__ = ["distributed_mask_select"]
+
+
+def _build_mask_select(mesh, axis_name, split, ndim, n_valid, per_out, flatten):
+    S = int(mesh.shape[axis_name])
+
+    def local(vals, mask):
+        r = lax.axis_index(axis_name)
+        v = jnp.moveaxis(vals, split, 0)
+        if flatten:
+            v = v.reshape(-1)
+            m = jnp.moveaxis(mask, split, 0).reshape(-1)
+        else:
+            m = mask
+        per = v.shape[0]
+        pos = r * per + jnp.arange(per)
+        keep = m & (pos < n_valid)
+        c = keep.sum(dtype=jnp.int32)
+        counts = lax.all_gather(c, axis_name)  # (S,) int32 — the count exchange
+        prefix = jnp.sum(jnp.where(jnp.arange(S) < r, counts, 0))
+        order = jnp.argsort(~keep, stable=True)  # survivors to the slab front
+        sel = jnp.take(v, order, axis=0)
+        i = jnp.arange(per)
+        # destination global position of the i-th survivor; past-count rows
+        # get an out-of-range sentinel and are dropped by the scatter
+        dest = jnp.where(i < c, prefix + i, S * per_out)
+        buf = jnp.zeros((S * per_out,) + sel.shape[1:], sel.dtype)
+        buf = buf.at[dest].set(sel, mode="drop")
+        out = lax.psum_scatter(buf, axis_name, scatter_dimension=0, tiled=True)
+        if not flatten:
+            out = jnp.moveaxis(out, 0, split)
+        return out
+
+    dim_spec = lambda nd, sdim: P(*[axis_name if d == sdim else None for d in range(nd)])
+    vals_spec = dim_spec(ndim, split)
+    mask_spec = vals_spec if flatten else P(axis_name)
+    out_spec = P(axis_name) if flatten else vals_spec
+    smapped = shard_map_unchecked(
+        local, mesh, in_specs=(vals_spec, mask_spec), out_specs=out_spec
+    )
+
+    def run(vals, mask):
+        # psum_scatter has no bool reduction: route bool payloads via uint8
+        isbool = vals.dtype == jnp.bool_
+        v = vals.astype(jnp.uint8) if isbool else vals
+        out = smapped(v, mask.astype(jnp.bool_))
+        return out.astype(jnp.bool_) if isbool else out
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _jit_mask_select(mesh, axis_name, split, ndim, n_valid, per_out, flatten):
+    # NB: the program depends on n_sel only through per_out = ceil(n_sel/S),
+    # so per_out (not n_sel) is the cache key — masks whose popcounts share a
+    # chunk size share one compiled executable
+    return jax.jit(
+        _build_mask_select(mesh, axis_name, split, ndim, n_valid, per_out, flatten)
+    )
+
+
+def distributed_mask_select(
+    phys_vals: jax.Array,
+    phys_mask: jax.Array,
+    mesh,
+    axis_name: str,
+    split: int,
+    n_valid: int,
+    n_sel: int,
+    flatten: bool = False,
+):
+    """Select ``phys_vals``'s elements where ``phys_mask`` holds, along the
+    sharded axis ``split`` (both in canonical physical layout).  Returns the
+    physical output: canonical even-chunk layout of extent ``n_sel`` along
+    the selection axis (``flatten=True``: a 1-D split-0 result).
+    ``n_sel`` must equal the mask's true count (host-known; see module doc).
+    """
+    S = int(mesh.shape[axis_name])
+    per_out = -(-int(n_sel) // S)
+    fn = _jit_mask_select(
+        mesh, axis_name, int(split), phys_vals.ndim, int(n_valid), per_out,
+        bool(flatten),
+    )
+    return fn(phys_vals, phys_mask)

@@ -284,24 +284,101 @@ class TestAdvancedSplitInference(TestCase):
         np.testing.assert_array_equal(got.numpy(), A[ii, jj])
 
     def test_boolean_mask_on_split_dim_stays_sharded(self):
-        # a pure 1-D mask on the split dim is eager (concrete extent), so
-        # even the data-dependent result stays sharded
+        # round 4: a pure 1-D mask on the split dim rides the distributed
+        # compact-and-rebalance program (parallel/select.py) — the result
+        # is sharded in the canonical even-chunk layout
         A = np.arange(35, dtype=np.float32).reshape(7, 5)
         x = ht.array(A, split=0)
         m = A[:, 0] > 10
         got = x[np.asarray(m)]
         self.assertEqual(got.split, 0)
         np.testing.assert_array_equal(got.numpy(), A[m])
+        per = -(-int(m.sum()) // self.comm.size)
+        shard_rows = {s.data.shape[0] for s in got.parray.addressable_shards}
+        self.assertEqual(shard_rows, {per})
 
-    def test_boolean_mixed_advanced_replicates(self):
-        # a mask MIXED with another advanced key joins a broadcast block
-        # of data-dependent extent — replicated by design
+    def test_boolean_mask_large_split_selection(self):
+        # big enough that every shard holds many rows; every split position
+        rng = np.random.default_rng(5)
+        A = rng.standard_normal((131, 6)).astype(np.float32)
+        m = A[:, 1] > 0
+        x = ht.array(A, split=0)
+        got = x[m]
+        self.assertEqual(got.split, 0)
+        np.testing.assert_array_equal(got.numpy(), A[m])
+        # trailing-slice spelling
+        np.testing.assert_array_equal(x[m, :].numpy(), A[m])
+        # mask on a non-zero split dim
+        B = rng.standard_normal((4, 131)).astype(np.float32)
+        mb = B[0] < 0.3
+        xb = ht.array(B, split=1)
+        gb = xb[:, mb]
+        self.assertEqual(gb.split, 1)
+        np.testing.assert_array_equal(gb.numpy(), B[:, mb])
+
+    def test_boolean_mask_dndarray_and_edge_counts(self):
+        A = np.arange(26, dtype=np.float32)
+        x = ht.array(A, split=0)
+        m = (A % 3) == 0
+        got = x[ht.array(m, split=0)]  # split DNDarray mask
+        self.assertEqual(got.split, 0)
+        np.testing.assert_array_equal(got.numpy(), A[m])
+        # empty and full selections; empty keeps the split (sharding must
+        # not depend on the mask's data)
+        empty = x[np.zeros(26, bool)]
+        self.assertEqual(empty.shape, (0,))
+        self.assertEqual(empty.split, 0)
+        np.testing.assert_array_equal(x[np.ones(26, bool)].numpy(), A)
+        # bool payload dtype (rides uint8 through the reduce-scatter)
+        xb = ht.array(A > 12, split=0)
+        np.testing.assert_array_equal(xb[m].numpy(), (A > 12)[m])
+        # wrong mask length
+        with self.assertRaises(IndexError):
+            x[np.ones(9, bool)]
+
+    def test_full_ndim_boolean_mask_stays_sharded(self):
+        rng = np.random.default_rng(6)
+        A = rng.standard_normal((19, 7)).astype(np.float32)
+        x = ht.array(A, split=0)
+        m = A > 0.4
+        got = x[m]
+        self.assertEqual(got.split, 0)
+        np.testing.assert_array_equal(got.numpy(), A[m])
+
+    def test_boolean_mixed_advanced_stays_sharded(self):
+        # round 4: a mask MIXED with another advanced key is rewritten to
+        # its nonzero indices (NumPy's equivalence) and rides the round-3
+        # sharded integer-gather path — no longer replicated
         A = np.arange(35, dtype=np.float32).reshape(7, 5)
         x = ht.array(A, split=0)
         m = np.array([True, False, True, False, True, False, True])
         got = x[np.asarray(m), np.array([0, 1, 2, 3])]
-        self.assertIsNone(got.split)
+        self.assertEqual(got.split, 0)
         np.testing.assert_array_equal(got.numpy(), A[m, [0, 1, 2, 3]])
+
+    def test_mask_select_program_never_gathers_input(self):
+        """The compiled mask-selection program's only collectives are the
+        S-scalar count exchange and ONE output-volume reduce-scatter — no
+        input-sized replicated buffer (round-4 VERDICT missing #2)."""
+        import re
+
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.select import _jit_mask_select
+
+        x = ht.array(np.zeros((4096, 16), np.float32), split=0)
+        n_sel = 2048
+        S = self.comm.size
+        fn = _jit_mask_select(
+            x.comm.mesh, x.comm.split_axis, 0, 2, 4096, -(-n_sel // S), False
+        )
+        txt = fn.lower(x.parray, jnp.zeros(4096, jnp.bool_)).compile().as_text()
+        ag_shapes = re.findall(r"= \w+\[([\d,]*)\][^=]*all-gather\(", txt)
+        for shape in ag_shapes:
+            elems = int(np.prod([int(d) for d in shape.split(",") if d]))
+            self.assertLessEqual(elems, S)  # only the count exchange
+        self.assertEqual(txt.count("reduce-scatter("), 1)
+        self.assertEqual(txt.count("all-to-all("), 0)
 
     def test_only_split_1d_stays_split(self):
         x = ht.array(np.arange(35, dtype=np.float32).reshape(7, 5), split=0)
