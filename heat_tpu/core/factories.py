@@ -13,6 +13,7 @@ single host→device transfer per shard when the input is host data).
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable, List, Optional, Sequence, Tuple, Type, Union
 
 import numpy as np
@@ -149,7 +150,25 @@ def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
     return _finalize(garray, split, device, comm)
 
 
-def __factory(shape, dtype, split, fill, device, comm, order="C") -> DNDarray:
+@functools.lru_cache(maxsize=512)
+def _factory_jit(kind: str, pshape, jdtype, sharding):
+    """One compiled fill program per (kind, shape, dtype, sharding).
+
+    Cached because a fresh ``jax.jit(lambda ...)`` per call misses jax's
+    trace cache (new function identity) and re-compiles every ``zeros``/
+    ``ones``/``full`` — a full compile round trip per factory call.  The
+    fill value for ``full`` rides as a traced operand so all values share
+    one program.
+    """
+    if kind == "full":
+        return jax.jit(
+            lambda v: jnp.full(pshape, v.astype(jdtype)), out_shardings=sharding
+        )
+    fill = jnp.zeros if kind == "zeros" else jnp.ones
+    return jax.jit(lambda: fill(pshape, jdtype), out_shardings=sharding)
+
+
+def __factory(shape, dtype, split, kind, device, comm, order="C", fill_value=None) -> DNDarray:
     """Shared shape-based factory (reference: factories.py:672)."""
     shape = sanitize_shape(shape)
     dtype = types.canonical_heat_type(dtype)
@@ -161,8 +180,11 @@ def __factory(shape, dtype, split, fill, device, comm, order="C") -> DNDarray:
     if split is not None and shape:
         pshape[split] = _physical_dim(shape[split], comm.size)
     sharding = comm.sharding(split, len(shape))
-    fn = jax.jit(lambda: fill(tuple(pshape), dtype.jax_type()), out_shardings=sharding)
-    garray = fn()
+    fn = _factory_jit(kind, tuple(pshape), jnp.dtype(dtype.jax_type()), sharding)
+    if kind == "full":
+        garray = fn(jnp.asarray(fill_value, dtype.jax_type()))
+    else:
+        garray = fn()
     return DNDarray(
         garray, shape, types.canonical_heat_type(garray.dtype),
         split, devices.sanitize_device(device), comm,
@@ -187,7 +209,7 @@ def __factory_like(a, dtype, split, factory, device, comm, **kwargs) -> DNDarray
 def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Uninitialized array (reference: factories.py:495). XLA has no
     uninitialized allocation; zeros are as cheap under fusion."""
-    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm)
+    return __factory(shape, dtype, split, "zeros", device, comm)
 
 
 def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -223,7 +245,7 @@ def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, orde
     if dtype is None:
         dtype = types.float32  # reference default (factories.py:946)
     value = fill_value.item() if hasattr(fill_value, "item") else fill_value
-    return __factory(shape, dtype, split, lambda s, d: jnp.full(s, value, d), device, comm)
+    return __factory(shape, dtype, split, "full", device, comm, fill_value=value)
 
 
 def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -278,7 +300,7 @@ def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
 
 def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Ones (reference: factories.py:1285)."""
-    return __factory(shape, dtype, split, lambda s, d: jnp.ones(s, d), device, comm)
+    return __factory(shape, dtype, split, "ones", device, comm)
 
 
 def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -287,7 +309,7 @@ def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> D
 
 def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Zeros (reference: factories.py:1382)."""
-    return __factory(shape, dtype, split, lambda s, d: jnp.zeros(s, d), device, comm)
+    return __factory(shape, dtype, split, "zeros", device, comm)
 
 
 def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:

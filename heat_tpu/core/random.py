@@ -12,6 +12,7 @@ mirrors the reference's stateful API.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Optional, Tuple, Type, Union
 
@@ -87,6 +88,43 @@ def set_state(state: Tuple) -> None:
 _CHUNK_F32_BYTES = 2 << 30  # chunk when the f32 intermediate would top 2 GB
 
 
+def _base_uniform(key, shape, dtype):
+    return jax.random.uniform(key, shape, dtype)
+
+
+def _base_normal(key, shape, dtype):
+    return jax.random.normal(key, shape, dtype)
+
+
+def _base_randint(key, shape, dtype, low, high):
+    # low/high ride as traced operands so every (shape, dtype) shares ONE
+    # compiled program regardless of the requested bounds
+    return jax.random.randint(key, shape, low, high, dtype=dtype)
+
+
+def _base_feistel(key, shape, dtype, rk):
+    """Keyed 8-round Feistel bijection of the element index over 32 bits
+    (see _perm_sort_keys for why a bijection and not independent draws)."""
+    del key  # randomness lives entirely in the round keys
+    i = jnp.arange(shape[0], dtype=jnp.uint32)
+    left, right = i >> 16, i & jnp.uint32(0xFFFF)
+    for j in range(8):
+        f = right * jnp.uint32(0x9E3779B9) ^ rk[j]
+        f = (f >> 13) & jnp.uint32(0xFFFF)
+        left, right = right, left ^ f
+    # bitcast, not astype: int32 convert of values >= 2^31 is not a
+    # bit-preserving map, which would break the bijection
+    return jax.lax.bitcast_convert_type((left << 16) | right, jnp.int32)
+
+
+_BASE_SAMPLERS = {
+    "uniform": _base_uniform,
+    "normal": _base_normal,
+    "randint": _base_randint,
+    "feistel": _base_feistel,
+}
+
+
 def _chunk_sampler(sampler, shape, jdtype):
     """Wrap ``sampler`` to generate big sub-f32 arrays in row blocks.
 
@@ -107,13 +145,13 @@ def _chunk_sampler(sampler, shape, jdtype):
     rows = -(-shape[0] // n_chunks)
     n_full, rem = divmod(shape[0], rows)
 
-    def chunked(key, _shape, _dtype):
+    def chunked(key, _shape, _dtype, *params):
         tail = tuple(shape[1:])
         zeros = (0,) * len(tail)
 
         def body(i, out):
             kb = jax.random.fold_in(key, i)
-            blk = sampler(kb, (rows,) + tail, _dtype)
+            blk = sampler(kb, (rows,) + tail, _dtype, *params)
             return jax.lax.dynamic_update_slice(out, blk, (i * rows,) + zeros)
 
         # the output buffer is allocated at the EXACT final shape and updated
@@ -123,14 +161,47 @@ def _chunk_sampler(sampler, shape, jdtype):
         out = jax.lax.fori_loop(0, n_full, body, out)
         if rem:
             kb = jax.random.fold_in(key, n_full)
-            blk = sampler(kb, (rem,) + tail, _dtype)
+            blk = sampler(kb, (rem,) + tail, _dtype, *params)
             out = jax.lax.dynamic_update_slice(out, blk, (n_full * rows,) + zeros)
         return out
 
     return chunked
 
 
-def _sharded_sample(shape, split, device, comm, sampler, jdtype, upcast=False) -> DNDarray:
+def _compose_sampler(kind: str, shape, jdtype, upcast: bool):
+    """Build the (possibly upcast- and chunk-wrapped) sampler for a kind."""
+    sampler = _BASE_SAMPLERS[kind]
+    if upcast:
+        base_sampler = sampler
+
+        def sampler(k, s, d, *params, _base=base_sampler):  # noqa: ANN001
+            # per block under _chunk_sampler: no array-sized f32 intermediate
+            return _base(k, s, jnp.float32, *params).astype(d)
+
+    # NOTE on layouts: the chunked program naturally emits jax-(0, 1)
+    # (row-major) output, which is ALSO what the blocked KMeans consumers'
+    # layout solvers prefer after the round-3 slim-down — no pin needed.
+    chunked = _chunk_sampler(sampler, shape, jdtype)
+    return chunked if chunked is not None else sampler
+
+
+@functools.lru_cache(maxsize=512)
+def _sampler_jit(kind: str, shape, jdtype, sharding, upcast: bool):
+    """One compiled program per (kind, shape, dtype, sharding, upcast).
+
+    The cache is the load-bearing part: a fresh ``jax.jit(lambda ...)`` per
+    call misses jax's own trace cache every time (new function identity) and
+    re-compiles — ~0.8 s per ``ht.random.*`` call through a remote-TPU
+    tunnel, the cost the round-3 cb suite recorded as "lanczos".
+    """
+    sampler = _compose_sampler(kind, shape, jdtype, upcast)
+    return jax.jit(
+        lambda key, *params: sampler(key, shape, jdtype, *params),
+        out_shardings=sharding,
+    )
+
+
+def _sharded_sample(shape, split, device, comm, kind, jdtype, upcast=False, params=()) -> DNDarray:
     """Generate a sharded sample: jit with out_shardings makes each device
     generate only its shard while the logical result is mesh-size-invariant.
 
@@ -143,34 +214,21 @@ def _sharded_sample(shape, split, device, comm, sampler, jdtype, upcast=False) -
     shape = sanitize_shape(shape)
     comm = sanitize_comm(comm)
     key = __next_key()
-    if upcast and jnp.issubdtype(jdtype, jnp.floating) and jnp.dtype(jdtype).itemsize < 4:
-        base_sampler = sampler
-
-        def sampler(k, s, d, _base=base_sampler):  # noqa: ANN001
-            # per block under _chunk_sampler: no array-sized f32 intermediate
-            return _base(k, s, jnp.float32).astype(d)
-
-    # NOTE on layouts: the chunked program naturally emits jax-(0, 1)
-    # (row-major) output, which is ALSO what the blocked KMeans consumers'
-    # layout solvers prefer after the round-3 slim-down — no pin needed.
-    # (An earlier revision pinned the opposite orientation for the fuller
-    # loop body; consumers bake the payload's actual format, so the
-    # at-rest layout and the solver preference only need to agree.)
-    chunked = _chunk_sampler(sampler, shape, jdtype)
-    if chunked is not None:
-        sampler = chunked
+    upcast = bool(
+        upcast and jnp.issubdtype(jdtype, jnp.floating) and jnp.dtype(jdtype).itemsize < 4
+    )
     split_ = split if len(shape) else None
     # mesh-size invariance: always sample at the LOGICAL shape (the physical
     # pad, if any, is zeros appended afterwards), so the same seed gives the
     # same global numbers for any mesh — the reference's core RNG contract
     if split_ is not None and shape[split_] % comm.size != 0:
-        garray = sampler(key, shape, jdtype)
+        sampler = _compose_sampler(kind, shape, jdtype, upcast)
+        garray = sampler(key, shape, jdtype, *params)
         garray = _to_physical(garray, shape, split_, comm)
     else:
         sharding = comm.sharding(split_, len(shape))
-        out = sharding
-        fn = jax.jit(lambda k: sampler(k, shape, jdtype), out_shardings=out)
-        garray = fn(key)
+        fn = _sampler_jit(kind, shape, jnp.dtype(jdtype), sharding, upcast)
+        garray = fn(key, *params)
     return DNDarray(
         garray, shape, types.canonical_heat_type(garray.dtype),
         split_, devices.sanitize_device(device), comm,
@@ -184,8 +242,8 @@ def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarra
         shape = tuple(shape[0])
     jdtype = types.canonical_heat_type(dtype).jax_type()
     if not shape:
-        return _sharded_sample((), None, device, comm, jax.random.uniform, jdtype)
-    return _sharded_sample(shape, split, device, comm, jax.random.uniform, jdtype)
+        return _sharded_sample((), None, device, comm, "uniform", jdtype)
+    return _sharded_sample(shape, split, device, comm, "uniform", jdtype)
 
 
 random_sample = rand
@@ -201,7 +259,7 @@ def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarr
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
     jdtype = types.canonical_heat_type(dtype).jax_type()
-    return _sharded_sample(shape, split, device, comm, jax.random.normal, jdtype, upcast=True)
+    return _sharded_sample(shape, split, device, comm, "normal", jdtype, upcast=True)
 
 
 standard_normal = randn
@@ -227,10 +285,12 @@ def randint(low, high=None, size=None, dtype=types.int32, split=None, device=Non
     if isinstance(size, int):
         size = (size,)
     jdtype = types.canonical_heat_type(dtype).jax_type()
+    # bounds ride in the widest int the mode allows: high is EXCLUSIVE, so
+    # e.g. uint8's legal high=256 doesn't fit the output dtype itself
+    bdtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     return _sharded_sample(
-        size, split, device, comm,
-        lambda k, s, d: jax.random.randint(k, s, int(low), int(high), dtype=d),
-        jdtype,
+        size, split, device, comm, "randint", jdtype,
+        params=(jnp.asarray(int(low), bdtype), jnp.asarray(int(high), bdtype)),
     )
 
 
@@ -252,20 +312,8 @@ def _perm_sort_keys(n: int, device, comm) -> DNDarray:
     order of a pseudorandom injection, and it stays a pure function of
     (seed, index) — mesh-size invariant like every other sampler here.
     """
-    rk = np.asarray(jax.random.bits(__next_key(), (8,), "uint32"))
-
-    def sampler(key, shape, dtype):
-        i = jnp.arange(shape[0], dtype=jnp.uint32)
-        left, right = i >> 16, i & jnp.uint32(0xFFFF)
-        for round_key in rk:
-            f = right * jnp.uint32(0x9E3779B9) ^ jnp.uint32(int(round_key))
-            f = (f >> 13) & jnp.uint32(0xFFFF)
-            left, right = right, left ^ f
-        # bitcast, not astype: int32 convert of values >= 2^31 is not a
-        # bit-preserving map, which would break the bijection
-        return jax.lax.bitcast_convert_type((left << 16) | right, jnp.int32)
-
-    return _sharded_sample((int(n),), 0, device, comm, sampler, jnp.int32)
+    rk = jax.random.bits(__next_key(), (8,), "uint32")
+    return _sharded_sample((int(n),), 0, device, comm, "feistel", jnp.int32, params=(rk,))
 
 
 def randperm(n: int, dtype=None, split=None, device=None, comm=None) -> DNDarray:
