@@ -35,20 +35,32 @@ def _qr_chain(a):
     return run_k
 
 
-def _tsqr_kernel_chain(arr):
+def _tsqr_kernel_chain(arr, mixed=False):
     # the CholeskyQR2 KERNEL (linalg/qr.py:_cholesky_qr2): the public
     # qr() adds one deliberate host sync per call (breakdown check,
     # qr.py:144-152) that a tunnel turns into a full round trip per link,
     # which no chain can cancel — so the throughput number times the
     # kernel, and tsqr_user_call records the synchronous surface cost
-    # separately
+    # separately (tsqr_user_call_defer times the check="defer" surface,
+    # which IS chainable)
     from heat_tpu.core.linalg.qr import _cholesky_qr2
 
     def run_k(k):
         c = arr
         for _ in range(k):
-            c, _ = _cholesky_qr2(c, calc_q=True)
+            c, _ = _cholesky_qr2(c, calc_q=True, mixed=mixed)
         config.drain(c)
+    return run_k
+
+
+def _qr_defer_chain(a):
+    # the public surface with check="defer": fully async, so the chain
+    # delta applies — each link re-factors the previous link's Q
+    def run_k(k):
+        c = a
+        for _ in range(k):
+            c = ht.linalg.qr(c, check="defer").Q
+        config.drain(c.larray)
     return run_k
 
 
@@ -85,19 +97,41 @@ def run():
         record(
             f"qr_split_{sp}", sl.per_unit_s, per="qr",
             **sl.fields(),
+            **config.mfu_fields(
+                config.qr_flops(qn, qn), sl.per_unit_s,
+                config.PEAK_F32_TFLOPS, "v5e f32 = bf16/4",
+            ),
         )
         del a
 
-    ts = ht.random.random((config.TSQR_M, config.TSQR_N), split=0)
+    tm, tn = config.TSQR_M, config.TSQR_N
+    ts_flops = config.qr_flops(tm, tn)
+    ts = ht.random.random((tm, tn), split=0)
     run_k = _tsqr_kernel_chain(ts.larray)
     run_k(1)
     sl = config.slope(run_k)
     record(
         "tsqr_tall_skinny", sl.per_unit_s, per="cholesky_qr2",
         surface="kernel", **sl.fields(),
+        **config.mfu_fields(
+            ts_flops, sl.per_unit_s, config.PEAK_F32_TFLOPS, "v5e f32 = bf16/4"
+        ),
     )
-    # the public surface: one call, including its deliberate breakdown-
-    # check sync (one tunnel round trip here; ~free on a colocated host)
+    # precision="mixed": pass-1 GEMMs in bf16/f32-accum (qr.py), the
+    # variant that clears the BASELINE 40%-MFU bar on the f32-peak model
+    run_k = _tsqr_kernel_chain(ts.larray, mixed=True)
+    run_k(1)
+    sl = config.slope(run_k)
+    record(
+        "tsqr_tall_skinny_mixed", sl.per_unit_s, per="cholesky_qr2",
+        surface="kernel", precision="mixed", **sl.fields(),
+        **config.mfu_fields(
+            ts_flops, sl.per_unit_s, config.PEAK_F32_TFLOPS, "v5e f32 = bf16/4"
+        ),
+    )
+    # the public surface, eager check: one call, including its deliberate
+    # breakdown-check sync (one tunnel round trip here; ~free on a
+    # colocated host)
     import time as _time
 
     config.drain(ht.linalg.qr(ts).R.larray)  # warmup
@@ -108,7 +142,39 @@ def run():
         method="single-run",
         note="includes one host sync (qr.py breakdown check)",
     )
+    # the public surface, check="defer": no sync, chain-delta applies
+    run_k = _qr_defer_chain(ts)
+    run_k(1)
+    sl = config.slope(run_k)
+    record(
+        "tsqr_user_call_defer", sl.per_unit_s, per="qr-call",
+        check="defer", **sl.fields(),
+        **config.mfu_fields(
+            ts_flops, sl.per_unit_s, config.PEAK_F32_TFLOPS, "v5e f32 = bf16/4"
+        ),
+    )
     del ts
+
+    # the BASELINE MFU-bar shape (1e6x1e3-class, compute-bound): f32 and
+    # mixed kernels, MFU scored against the f32 peak model.  The n=128
+    # rows above are HBM-bound (~22% MFU is their arithmetic-intensity
+    # ceiling); this shape is where the 40% bar is meaningful.
+    wm, wn = config.TSQR_WIDE_M, config.TSQR_WIDE_N
+    w_flops = config.qr_flops(wm, wn)
+    wide = ht.random.random((wm, wn), split=0)
+    for mixed, row in ((False, "tsqr_wide"), (True, "tsqr_wide_mixed")):
+        run_k = _tsqr_kernel_chain(wide.larray, mixed=mixed)
+        run_k(1)
+        sl = config.slope(run_k)
+        record(
+            row, sl.per_unit_s, per="cholesky_qr2",
+            surface="kernel", shape=[wm, wn],
+            **({"precision": "mixed"} if mixed else {}), **sl.fields(),
+            **config.mfu_fields(
+                w_flops, sl.per_unit_s, config.PEAK_F32_TFLOPS, "v5e f32 = bf16/4"
+            ),
+        )
+    del wide
 
     ln = 50
     A = ht.random.random((ln, ln), dtype=ht.float64, split=0)

@@ -247,3 +247,54 @@ class TestDistributedDetInv(TestCase):
         self.assertEqual(out.split, 0)
         shard_rows = {s.data.shape[0] for s in out.parray.addressable_shards}
         self.assertEqual(shard_rows, {32 // self.comm.size})
+
+
+class TestQROptions(TestCase):
+    """check="defer" and precision="mixed" on the CholeskyQR2 path
+    (qr.py: breakdown contract + mixed-precision pass-1)."""
+
+    def test_defer_matches_eager_when_well_conditioned(self):
+        a = ht.random.random((64, 8), split=None)
+        eager = ht.linalg.qr(a)
+        defer = ht.linalg.qr(a, check="defer")
+        np.testing.assert_allclose(
+            np.asarray(defer.R.larray), np.asarray(eager.R.larray), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(defer.Q.larray), np.asarray(eager.Q.larray), rtol=1e-5
+        )
+
+    def test_defer_nan_latches_on_breakdown(self):
+        # rank-deficient input: Gram is singular, Cholesky fails, and the
+        # deferred path must surface NaN (never finite garbage)
+        col = np.arange(40, dtype=np.float32)
+        a = ht.array(np.stack([col, 2 * col, 3 * col], axis=1))
+        defer = ht.linalg.qr(a, check="defer")
+        self.assertFalse(bool(np.isfinite(np.asarray(defer.R.larray)).all()))
+        # eager path detects it and falls back to Householder: finite R
+        eager = ht.linalg.qr(a)
+        self.assertTrue(bool(np.isfinite(np.asarray(eager.R.larray)).all()))
+
+    def test_invalid_check_raises(self):
+        a = ht.random.random((16, 4))
+        with self.assertRaises(ValueError):
+            ht.linalg.qr(a, check="lazy")
+        with self.assertRaises(ValueError):
+            ht.linalg.qr(a, precision="float16")
+
+    def test_mixed_precision_orthogonality(self):
+        # mixed keeps orthogonality at f32 level; reconstruction at bf16
+        # working precision (the documented trade, qr.py docstring)
+        rng = np.random.default_rng(3)
+        host = rng.standard_normal((4096, 64)).astype(np.float32)
+        a = ht.array(host)
+        q, r = ht.linalg.qr(a, precision="mixed")
+        qn = np.asarray(q.larray)
+        rn = np.asarray(r.larray)
+        orth = np.linalg.norm(np.eye(64) - qn.T @ qn)
+        self.assertLess(orth, 1e-3)
+        recon = np.linalg.norm(host - qn @ rn) / np.linalg.norm(host)
+        self.assertLess(recon, 2e-2)
+        # R upper-triangular with nonnegative diagonal
+        self.assertTrue(np.allclose(rn, np.triu(rn)))
+        self.assertTrue((np.diag(rn) >= 0).all())

@@ -1,0 +1,302 @@
+"""Linear-algebra case matrix (reference model: heat/core/linalg/tests/
+test_basics.py, 2155 LoC — the full split-dispatch table of matmul plus
+dot/outer/norm/trace/tri{l,u} across shapes and splits).
+
+Under GSPMD there is no dispatch table to test — one einsum covers every
+split pair — but the CONTRACT the table proved still needs proving: any
+(a.split, b.split) combination, odd shapes, batched operands, and the
+decomposition family (det/inv/svd/solve) against NumPy oracles, with
+per-shard slab checks on distributed results.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+def _splits(ndim):
+    return [None] + list(range(ndim))
+
+
+class TestMatmulShapes(TestCase):
+    def test_odd_shape_split_matrix(self):
+        rng = np.random.default_rng(301)
+        a = rng.standard_normal((13, 9)).astype(np.float32)
+        b = rng.standard_normal((9, 11)).astype(np.float32)
+        expected = a @ b
+        for sa in _splits(2):
+            for sb in _splits(2):
+                with self.subTest(sa=sa, sb=sb):
+                    r = ht.matmul(ht.array(a, split=sa), ht.array(b, split=sb))
+                    self.assert_array_equal(r, expected, rtol=1e-4)
+
+    def test_matvec_and_vecmat(self):
+        rng = np.random.default_rng(303)
+        m = rng.standard_normal((13, 7)).astype(np.float32)
+        v = rng.standard_normal(7).astype(np.float32)
+        w = rng.standard_normal(13).astype(np.float32)
+        for sm in _splits(2):
+            with self.subTest(sm=sm):
+                self.assert_array_equal(
+                    ht.matmul(ht.array(m, split=sm), ht.array(v, split=0)),
+                    m @ v, rtol=1e-4,
+                )
+                self.assert_array_equal(
+                    ht.matmul(ht.array(w, split=0), ht.array(m, split=sm)),
+                    w @ m, rtol=1e-4,
+                )
+
+    def test_batched_matmul(self):
+        rng = np.random.default_rng(305)
+        a = rng.standard_normal((5, 6, 4)).astype(np.float32)
+        b = rng.standard_normal((5, 4, 3)).astype(np.float32)
+        expected = a @ b
+        for s in _splits(3):
+            with self.subTest(split=s):
+                r = ht.matmul(ht.array(a, split=s), ht.array(b, split=s if s != 2 else None))
+                self.assert_array_equal(r, expected, rtol=1e-4)
+
+    def test_inner_dim_mismatch_raises(self):
+        a = ht.array(np.ones((3, 4), np.float32), split=0)
+        b = ht.array(np.ones((5, 3), np.float32), split=0)
+        with self.assertRaises((ValueError, TypeError)):
+            ht.matmul(a, b)
+
+    def test_dot_semantics(self):
+        rng = np.random.default_rng(307)
+        v1 = rng.standard_normal(17).astype(np.float32)
+        v2 = rng.standard_normal(17).astype(np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                r = ht.dot(ht.array(v1, split=s), ht.array(v2, split=s))
+                np.testing.assert_allclose(float(r.numpy()), v1 @ v2, rtol=1e-4)
+
+    def test_vdot_conjugates(self):
+        v1 = (np.arange(5) + 1j * np.arange(5)).astype(np.complex64)
+        v2 = (np.ones(5) - 1j * np.arange(5)).astype(np.complex64)
+        r = ht.vdot(ht.array(v1, split=0), ht.array(v2, split=0))
+        np.testing.assert_allclose(complex(r.numpy()), np.vdot(v1, v2), rtol=1e-5)
+
+    def test_outer_all_splits(self):
+        rng = np.random.default_rng(309)
+        v1 = rng.standard_normal(9).astype(np.float32)
+        v2 = rng.standard_normal(13).astype(np.float32)
+        expected = np.outer(v1, v2)
+        for s1 in (None, 0):
+            for s2 in (None, 0):
+                with self.subTest(s1=s1, s2=s2):
+                    r = ht.outer(ht.array(v1, split=s1), ht.array(v2, split=s2))
+                    self.assert_array_equal(r, expected, rtol=1e-5)
+
+    def test_cross(self):
+        rng = np.random.default_rng(311)
+        a = rng.standard_normal((8, 3)).astype(np.float32)
+        b = rng.standard_normal((8, 3)).astype(np.float32)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                r = ht.cross(ht.array(a, split=s), ht.array(b, split=s))
+                self.assert_array_equal(r, np.cross(a, b), rtol=1e-4)
+
+
+class TestNormTraceTri(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(313)
+        self.m = rng.standard_normal((9, 12)).astype(np.float32)
+        self.v = rng.standard_normal(23).astype(np.float32)
+
+    def test_vector_norms(self):
+        for ord_ in (None, 1, 2, np.inf):
+            expected = np.linalg.norm(self.v, ord=ord_)
+            for s in (None, 0):
+                with self.subTest(ord=ord_, split=s):
+                    r = ht.norm(ht.array(self.v, split=s), ord=ord_)
+                    np.testing.assert_allclose(float(r.numpy()), expected, rtol=1e-4)
+
+    def test_matrix_norms(self):
+        for ord_ in ("fro", 1, np.inf):
+            expected = np.linalg.norm(self.m, ord=ord_)
+            for s in _splits(2):
+                with self.subTest(ord=ord_, split=s):
+                    r = ht.matrix_norm(ht.array(self.m, split=s), ord=ord_)
+                    np.testing.assert_allclose(
+                        float(np.asarray(r.numpy()).squeeze()), expected, rtol=1e-4
+                    )
+
+    def test_trace_offsets(self):
+        for off in (0, 1, -2):
+            expected = np.trace(self.m, off)
+            for s in _splits(2):
+                with self.subTest(offset=off, split=s):
+                    r = ht.trace(ht.array(self.m, split=s), off)
+                    np.testing.assert_allclose(float(np.asarray(r.numpy()).squeeze()), expected, rtol=1e-4)
+
+    def test_tril_triu_offsets(self):
+        for off in (0, 1, -1, 3):
+            for s in _splits(2):
+                with self.subTest(offset=off, split=s):
+                    self.assert_array_equal(
+                        ht.tril(ht.array(self.m, split=s), off), np.tril(self.m, off)
+                    )
+                    self.assert_array_equal(
+                        ht.triu(ht.array(self.m, split=s), off), np.triu(self.m, off)
+                    )
+
+
+class TestDetInvMatrix(TestCase):
+    def _spd(self, n, seed):
+        rng = np.random.default_rng(seed)
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        return (a @ a.T + n * np.eye(n, dtype=np.float32)).astype(np.float32)
+
+    def test_det_sizes_and_splits(self):
+        for n in (1, 2, 5, 13):
+            m = self._spd(n, n)
+            expected = np.linalg.det(m.astype(np.float64))
+            for s in _splits(2):
+                with self.subTest(n=n, split=s):
+                    r = ht.linalg.det(ht.array(m, split=s))
+                    np.testing.assert_allclose(
+                        float(r.numpy()), expected, rtol=1e-2
+                    )
+
+    def test_det_singular_is_zero(self):
+        m = np.ones((4, 4), np.float32)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                r = ht.linalg.det(ht.array(m, split=s))
+                np.testing.assert_allclose(float(r.numpy()), 0.0, atol=1e-4)
+
+    def test_det_sign_from_permutation(self):
+        # a permutation matrix's det is the permutation's sign
+        p = np.eye(5, dtype=np.float32)[[1, 0, 2, 4, 3]]
+        for s in _splits(2):
+            with self.subTest(split=s):
+                r = ht.linalg.det(ht.array(p, split=s))
+                np.testing.assert_allclose(float(r.numpy()), 1.0, rtol=1e-4)
+
+    def test_inv_roundtrip(self):
+        for n in (2, 7, 12):
+            m = self._spd(n, 100 + n)
+            for s in _splits(2):
+                with self.subTest(n=n, split=s):
+                    inv = ht.linalg.inv(ht.array(m, split=s))
+                    np.testing.assert_allclose(
+                        inv.numpy() @ m, np.eye(n), atol=1e-3
+                    )
+
+    def test_inv_matches_numpy(self):
+        m = self._spd(6, 17)
+        expected = np.linalg.inv(m)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                r = ht.linalg.inv(ht.array(m, split=s))
+                self.assert_array_equal(r, expected, rtol=1e-2, atol=1e-4)
+
+
+class TestQRSVDMatrix(TestCase):
+    def test_qr_reconstruction_shapes(self):
+        rng = np.random.default_rng(317)
+        for (m, n) in [(16, 16), (64, 8), (128, 16), (15, 7)]:
+            host = rng.standard_normal((m, n)).astype(np.float32)
+            for s in _splits(2):
+                with self.subTest(shape=(m, n), split=s):
+                    q, r = ht.linalg.qr(ht.array(host, split=s))
+                    qn, rn = q.numpy(), r.numpy()
+                    np.testing.assert_allclose(qn @ rn, host, atol=1e-3)
+                    np.testing.assert_allclose(
+                        qn.T @ qn, np.eye(n), atol=1e-3
+                    )
+                    # R upper triangular, nonneg diagonal (sign convention)
+                    np.testing.assert_allclose(rn, np.triu(rn), atol=1e-5)
+                    self.assertTrue((np.diag(rn) >= -1e-5).all())
+
+    def test_qr_r_only(self):
+        rng = np.random.default_rng(319)
+        host = rng.standard_normal((96, 12)).astype(np.float32)
+        full = ht.linalg.qr(ht.array(host, split=0))
+        ronly = ht.linalg.qr(ht.array(host, split=0), calc_q=False)
+        self.assertIsNone(ronly.Q)
+        np.testing.assert_allclose(ronly.R.numpy(), full.R.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_svd_reconstruction(self):
+        rng = np.random.default_rng(323)
+        for (m, n) in [(64, 8), (40, 12)]:
+            host = rng.standard_normal((m, n)).astype(np.float32)
+            for s in (None, 0):
+                with self.subTest(shape=(m, n), split=s):
+                    # heat convention: returns V (a = U diag(S) V^T)
+                    u, sv, v = ht.linalg.svd(ht.array(host, split=s))
+                    un, svn, vtn = u.numpy(), sv.numpy(), v.numpy().T
+                    np.testing.assert_allclose(
+                        un @ np.diag(svn) @ vtn, host, atol=1e-2
+                    )
+                    # singular values sorted descending, nonnegative
+                    self.assertTrue((np.diff(svn) <= 1e-5).all())
+                    self.assertTrue((svn >= -1e-6).all())
+                    np.testing.assert_allclose(
+                        svn, np.linalg.svd(host, compute_uv=False), rtol=1e-3, atol=1e-3
+                    )
+
+    def test_cg_solves_spd(self):
+        rng = np.random.default_rng(329)
+        a = rng.standard_normal((24, 24)).astype(np.float32)
+        A = a @ a.T + 24 * np.eye(24, dtype=np.float32)
+        x_true = rng.standard_normal(24).astype(np.float32)
+        b = A @ x_true
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.linalg.cg(
+                    ht.array(A, split=s), ht.array(b, split=0 if s is not None else None),
+                    ht.zeros(24, split=0 if s is not None else None),
+                )
+                np.testing.assert_allclose(x.numpy(), x_true, rtol=1e-2, atol=1e-3)
+
+    def test_lanczos_tridiagonalizes(self):
+        rng = np.random.default_rng(331)
+        a = rng.standard_normal((30, 30)).astype(np.float32)
+        B = (a @ a.T).astype(np.float32)
+        for m in (5, 15, 30):
+            with self.subTest(m=m):
+                V, T = ht.lanczos(ht.array(B, split=0), m=m)
+                Vn, Tn = V.numpy(), T.numpy()
+                self.assertEqual(Vn.shape, (30, m))
+                self.assertEqual(Tn.shape, (m, m))
+                np.testing.assert_allclose(Vn.T @ Vn, np.eye(m), atol=1e-3)
+                # T is tridiagonal
+                mask = np.abs(np.subtract.outer(np.arange(m), np.arange(m))) > 1
+                np.testing.assert_allclose(Tn[mask], 0, atol=1e-5)
+                # similarity: V^T B V = T
+                np.testing.assert_allclose(Vn.T @ B @ Vn, Tn, atol=2e-2)
+
+
+class TestLinalgChains(TestCase):
+    """Decomposition outputs feeding further distributed ops."""
+
+    def test_qr_then_solve_least_squares(self):
+        rng = np.random.default_rng(337)
+        A = rng.standard_normal((200, 6)).astype(np.float32)
+        x_true = rng.standard_normal(6).astype(np.float32)
+        b = A @ x_true + 0.001 * rng.standard_normal(200).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(A, split=0))
+        # x = R^{-1} Q^T b
+        qtb = ht.matmul(q.T, ht.array(b, split=0))
+        x = ht.matmul(ht.linalg.inv(r), qtb)
+        np.testing.assert_allclose(x.numpy(), x_true, atol=1e-2)
+
+    def test_inv_of_gram_matrix(self):
+        rng = np.random.default_rng(341)
+        A = rng.standard_normal((50, 8)).astype(np.float32)
+        x = ht.array(A, split=0)
+        g = ht.matmul(x.T, x) + ht.array(8 * np.eye(8, dtype=np.float32))
+        ginv = ht.linalg.inv(g)
+        expected = np.linalg.inv(A.T @ A + 8 * np.eye(8))
+        np.testing.assert_allclose(ginv.numpy(), expected, rtol=1e-2, atol=1e-4)
+
+    def test_norm_of_qr_residual(self):
+        rng = np.random.default_rng(347)
+        A = rng.standard_normal((128, 16)).astype(np.float32)
+        x = ht.array(A, split=0)
+        q, r = ht.linalg.qr(x)
+        resid = ht.matmul(q, r) - x
+        self.assertLess(float(ht.norm(ht.ravel(resid)).numpy()), 1e-2)
