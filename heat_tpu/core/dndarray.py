@@ -613,6 +613,45 @@ class DNDarray:
             fill = (slice(None),) * (self.ndim - n_specified)
             key = key[:e] + fill + key[e + 1 :]
 
+        if n_specified > self.ndim:
+            raise IndexError(
+                f"too many indices: array is {self.ndim}-D, got {n_specified}"
+            )
+        # bounds-check host-side integer keys: jax silently CLAMPS
+        # out-of-range indices, which breaks python's iteration protocol
+        # (``for row in x`` stops on IndexError) and hides caller bugs.
+        # Traced/device index arrays keep jax's clamp semantics — checking
+        # them would force a device sync per getitem.
+        dim = 0
+        for k in key:
+            if k is None:
+                continue
+            is_bool_arr = (
+                isinstance(k, (np.ndarray, jnp.ndarray, jax.Array))
+                and np.ndim(k) > 0
+                and k.dtype == np.bool_
+            )
+            if is_bool_arr:
+                dim += np.ndim(k)  # a mask consumes one dim per mask dim
+                continue
+            if isinstance(k, (int, np.integer)):
+                n = self.__gshape[dim] if dim < self.ndim else 0
+                if not (-n <= int(k) < n):
+                    raise IndexError(
+                        f"index {int(k)} is out of bounds for dimension {dim} "
+                        f"with size {n}"
+                    )
+            elif isinstance(k, (list, np.ndarray)) and np.ndim(k) > 0:
+                ka = np.asarray(k)
+                n = self.__gshape[dim] if dim < self.ndim else 0
+                if ka.size and (int(ka.min()) < -n or int(ka.max()) >= n):
+                    raise IndexError(
+                        f"index array with values in [{int(ka.min())}, "
+                        f"{int(ka.max())}] is out of bounds for dimension "
+                        f"{dim} with size {n}"
+                    )
+            dim += 1
+
         advanced = any(
             isinstance(k, (jnp.ndarray, jax.Array, np.ndarray)) and np.ndim(k) > 0
             for k in key

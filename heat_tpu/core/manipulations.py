@@ -34,6 +34,7 @@ __all__ = [
     "diag",
     "diagonal",
     "dsplit",
+    "dstack",
     "expand_dims",
     "flatten",
     "flip",
@@ -120,6 +121,17 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
         raise TypeError("expected at least one DNDarray input")
     axis = stride_tricks.sanitize_axis(ref.shape, axis)
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    # validate up front so shape mismatches surface as ValueError (the
+    # reference's error class) instead of jax's TypeError at dispatch
+    for p in prepared[1:]:
+        if p.ndim != prepared[0].ndim or any(
+            p.shape[d] != prepared[0].shape[d]
+            for d in range(p.ndim) if d != axis
+        ):
+            raise ValueError(
+                "all input array dimensions except the concatenation axis "
+                f"must match: {prepared[0].shape} vs {p.shape} on axis {axis}"
+            )
     result = jnp.concatenate(prepared, axis=axis)
     split = next((a.split for a in arrays if isinstance(a, DNDarray) and a.split is not None), None)
     return _wrap(result, ref, split)
@@ -196,6 +208,22 @@ def hstack(arrays: Sequence[DNDarray]) -> DNDarray:
     return concatenate(arrays, axis=axis)
 
 
+def dstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Depth-wise stack along the third axis (numpy parity; the reference
+    ships vstack/hstack/row_stack only — dstack completes the family the
+    same way dsplit already does)."""
+    ref = next(a for a in arrays if isinstance(a, DNDarray))
+    prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    result = jnp.dstack(prepared)
+    if ref.ndim == 1:
+        # dstack maps a 1-D input's data axis to output axis 1 (shape
+        # (1, n, k)); a split=0 input's distribution follows it there
+        split = 1 if ref.split == 0 else None
+    else:
+        split = ref.split if (ref.split is not None and ref.split < 2) else None
+    return _wrap(result, ref, split)
+
+
 def moveaxis(x: DNDarray, source, destination) -> DNDarray:
     """Move axes to new positions (reference: manipulations.py moveaxis)."""
     sanitation.sanitize_in(x)
@@ -255,6 +283,17 @@ def reshape(a: DNDarray, *shape, new_split=None) -> DNDarray:
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
     shape = stride_tricks.sanitize_shape(shape, lval=-1)
+    known = [d for d in shape if d != -1]
+    n_unknown = sum(1 for d in shape if d == -1)
+    prod = int(np.prod(known)) if known else 1
+    if n_unknown > 1:
+        raise ValueError("can only specify one unknown dimension")
+    if (n_unknown == 0 and prod != a.size) or (
+        n_unknown == 1 and (prod == 0 or a.size % prod != 0)
+    ):
+        raise ValueError(
+            f"cannot reshape array of size {a.size} into shape {tuple(shape)}"
+        )
     result = jnp.reshape(a.larray, shape)
     if new_split is None:
         if a.split is None:

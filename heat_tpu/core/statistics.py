@@ -222,15 +222,16 @@ def maximum(x1, x2, out=None, where=None) -> DNDarray:
     return _operations._binary_op(jnp.maximum, x1, x2, out=out, where=where)
 
 
-def mean(x, axis=None) -> DNDarray:
+def mean(x, axis=None, keepdims: bool = False) -> DNDarray:
     """Arithmetic mean (reference: statistics.py:892 — merged-moments
-    Allreduce there, one partitioned jnp.mean here)."""
+    Allreduce there, one partitioned jnp.mean here; ``keepdims`` is a
+    numpy-parity extension the reference lacks)."""
     return _operations._reduce_op(
         lambda t, axis=None, keepdims=False, dtype=None: jnp.mean(
             t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
             axis=axis, keepdims=keepdims, dtype=dtype,
         ),
-        x, axis=axis,
+        x, axis=axis, keepdims=keepdims,
     )
 
 
@@ -332,18 +333,18 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
     return wrapped
 
 
-def std(x, axis=None, ddof: int = 0) -> DNDarray:
+def std(x, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarray:
     """Standard deviation (reference: statistics.py:1724)."""
     return _operations._reduce_op(
         lambda t, axis=None, keepdims=False, dtype=None: jnp.std(
             t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
             axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype,
         ),
-        x, axis=axis,
+        x, axis=axis, keepdims=keepdims,
     )
 
 
-def var(x, axis=None, ddof: int = 0) -> DNDarray:
+def var(x, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarray:
     """Variance (reference: statistics.py:1857 — Bennett merged moments there,
     one partitioned jnp.var here)."""
     return _operations._reduce_op(
@@ -351,7 +352,7 @@ def var(x, axis=None, ddof: int = 0) -> DNDarray:
             t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
             axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype,
         ),
-        x, axis=axis,
+        x, axis=axis, keepdims=keepdims,
     )
 
 

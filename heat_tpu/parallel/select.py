@@ -94,7 +94,7 @@ def _build_mask_select(mesh, axis_name, split, ndim, n_valid, per_out, flatten):
     return run
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=512)
 def _jit_mask_select(mesh, axis_name, split, ndim, n_valid, per_out, flatten):
     # NB: the program depends on n_sel only through per_out = ceil(n_sel/S),
     # so per_out (not n_sel) is the cache key — masks whose popcounts share a

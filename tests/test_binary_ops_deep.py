@@ -1,0 +1,311 @@
+"""Binary-op distribution matrix (reference model: the reference's
+test_arithmetics.py + the op-machinery cases of test_operations.py —
+every (a.split, b.split) pair x broadcast shape x dtype pair).
+
+The GSPMD op machinery (core/_operations.py) resolves operand
+distributions with a dominance rule (a split operand's layout wins; two
+split operands must agree after broadcasting).  This matrix proves the
+rule over the full (split_a, split_b) space with NumPy as the oracle,
+including the broadcast cases where the split dimension is size-1 on one
+side — the cases where a wrong dominance choice silently produces a
+correct-shaped but wrong-valued result.
+"""
+
+import operator
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+def _splits(ndim):
+    return [None] + list(range(ndim))
+
+
+OPS = [
+    ("add", operator.add),
+    ("sub", operator.sub),
+    ("mul", operator.mul),
+    ("truediv", operator.truediv),
+    ("pow", operator.pow),
+    ("mod", operator.mod),
+    ("floordiv", operator.floordiv),
+]
+
+CMPS = [
+    ("lt", operator.lt),
+    ("le", operator.le),
+    ("gt", operator.gt),
+    ("ge", operator.ge),
+    ("eq", operator.eq),
+    ("ne", operator.ne),
+]
+
+
+class TestSameShapeSplitPairs(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(201)
+        self.a = (rng.standard_normal((13, 7)) + 2.0).astype(np.float32)
+        self.b = (rng.standard_normal((13, 7)) + 3.0).astype(np.float32)
+
+    def test_arith_all_split_pairs(self):
+        for name, op in OPS:
+            expected = op(self.a, self.b)
+            for sa in _splits(2):
+                for sb in _splits(2):
+                    with self.subTest(op=name, sa=sa, sb=sb):
+                        r = op(ht.array(self.a, split=sa), ht.array(self.b, split=sb))
+                        self.assert_array_equal(r, expected, rtol=1e-4)
+
+    def test_compare_all_split_pairs(self):
+        for name, op in CMPS:
+            expected = op(self.a, self.b)
+            for sa in _splits(2):
+                for sb in _splits(2):
+                    with self.subTest(op=name, sa=sa, sb=sb):
+                        r = op(ht.array(self.a, split=sa), ht.array(self.b, split=sb))
+                        self.assert_array_equal(r, expected)
+
+    def test_result_split_dominance(self):
+        # split operand dominates replicated: result carries the split
+        for sa in (0, 1):
+            r = ht.array(self.a, split=sa) + ht.array(self.b, split=None)
+            self.assertEqual(r.split, sa)
+            r = ht.array(self.a, split=None) + ht.array(self.b, split=sa)
+            self.assertEqual(r.split, sa)
+
+
+class TestBroadcastSplitMatrix(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(203)
+        self.m = rng.standard_normal((13, 7)).astype(np.float32)
+        self.row = rng.standard_normal((1, 7)).astype(np.float32)
+        self.col = rng.standard_normal((13, 1)).astype(np.float32)
+        self.v = rng.standard_normal(7).astype(np.float32)
+
+    def test_row_broadcast_all_splits(self):
+        expected = self.m + self.row
+        for sm in _splits(2):
+            for sr in _splits(2):
+                with self.subTest(sm=sm, sr=sr):
+                    r = ht.array(self.m, split=sm) + ht.array(self.row, split=sr)
+                    self.assert_array_equal(r, expected, rtol=1e-5)
+
+    def test_col_broadcast_all_splits(self):
+        expected = self.m * self.col
+        for sm in _splits(2):
+            for sc in _splits(2):
+                with self.subTest(sm=sm, sc=sc):
+                    r = ht.array(self.m, split=sm) * ht.array(self.col, split=sc)
+                    self.assert_array_equal(r, expected, rtol=1e-5)
+
+    def test_vector_broadcast(self):
+        expected = self.m - self.v
+        for sm in _splits(2):
+            for sv in (None, 0):
+                with self.subTest(sm=sm, sv=sv):
+                    r = ht.array(self.m, split=sm) - ht.array(self.v, split=sv)
+                    self.assert_array_equal(r, expected, rtol=1e-5)
+
+    def test_rank_promotion_3d(self):
+        rng = np.random.default_rng(205)
+        t = rng.standard_normal((4, 5, 6)).astype(np.float32)
+        m = rng.standard_normal((5, 6)).astype(np.float32)
+        expected = t + m
+        for st in _splits(3):
+            for sm in _splits(2):
+                with self.subTest(st=st, sm=sm):
+                    r = ht.array(t, split=st) + ht.array(m, split=sm)
+                    self.assert_array_equal(r, expected, rtol=1e-5)
+
+    def test_scalar_sized_operand(self):
+        one = np.asarray([[2.0]], np.float32)
+        expected = self.m / one
+        for sm in _splits(2):
+            with self.subTest(sm=sm):
+                r = ht.array(self.m, split=sm) / ht.array(one)
+                self.assert_array_equal(r, expected, rtol=1e-5)
+
+    def test_incompatible_shapes_raise(self):
+        a = ht.array(self.m, split=0)
+        b = ht.array(np.ones((13, 5), np.float32), split=0)
+        with self.assertRaises((ValueError, TypeError)):
+            a + b
+
+
+class TestScalarOperandMatrix(TestCase):
+    def setUp(self):
+        self.f = np.linspace(-3, 3, 21).astype(np.float32)
+        self.i = np.arange(-10, 11).astype(np.int32)
+
+    def test_python_scalar_left_and_right(self):
+        for name, op in OPS:
+            if name in ("mod", "floordiv"):
+                continue  # sign conventions at negatives tested separately
+            for s in (None, 0):
+                with self.subTest(op=name, split=s):
+                    x = ht.array(self.f, split=s)
+                    self.assert_array_equal(op(x, 2.5), op(self.f, np.float32(2.5)), rtol=1e-5)
+                    self.assert_array_equal(op(2.5, x), op(np.float32(2.5), self.f), rtol=1e-5)
+
+    def test_scalar_keeps_array_dtype(self):
+        # python scalars must not widen array dtypes (reference semantics,
+        # round-3 commits e12fde9/6c247b4)
+        x = ht.array(self.f, split=0)
+        self.assertEqual((x + 1).dtype, ht.float32)
+        self.assertEqual((1 + x).dtype, ht.float32)
+        self.assertEqual((x * 2.0).dtype, ht.float32)
+        xi = ht.array(self.i, split=0)
+        self.assertEqual((xi + 1).dtype, ht.int32)
+        self.assertEqual((xi + 1.5).dtype, ht.float32)
+
+    def test_int_scalar_ops_on_int_array(self):
+        xi = ht.array(self.i, split=0)
+        self.assert_array_equal(xi + 3, self.i + 3)
+        self.assert_array_equal(xi * -2, self.i * -2)
+        self.assert_array_equal(xi // 3, self.i // 3)
+        self.assert_array_equal(xi % 4, self.i % 4)
+
+    def test_mod_floordiv_negative_semantics(self):
+        # python/numpy floor semantics (not C trunc) — both sides
+        a = np.asarray([-7, -3, 3, 7], np.int32)
+        b = np.asarray([3, -3, -3, 3], np.int32)
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        self.assert_array_equal(x % y, a % b)
+        self.assert_array_equal(x // y, a // b)
+
+
+class TestDtypePromotionPairs(TestCase):
+    """The promotion lattice over binary ops — reference-exact pairs
+    (core/types.py; the reference tests these in test_types.py)."""
+
+    PAIRS = [
+        (np.int32, np.int64, ht.int64),
+        (np.int32, np.float32, ht.float32),
+        (np.int64, np.float32, ht.float32),
+        (np.float32, np.float64, ht.float64),
+        (np.uint8, np.int32, ht.int32),
+        (np.bool_, np.int32, ht.int32),
+        (np.bool_, np.float32, ht.float32),
+        (np.int8, np.uint8, ht.int16),
+    ]
+
+    def test_add_promotes_pairwise(self):
+        for dt_a, dt_b, want in self.PAIRS:
+            with self.subTest(pair=(dt_a, dt_b)):
+                a = ht.array(np.ones(5, dt_a), split=0)
+                b = ht.array(np.ones(5, dt_b), split=0)
+                self.assertEqual((a + b).dtype, want)
+                self.assertEqual((b + a).dtype, want)
+
+    def test_division_always_floats(self):
+        a = ht.array(np.arange(1, 6, dtype=np.int32), split=0)
+        b = ht.array(np.arange(1, 6, dtype=np.int64), split=0)
+        r = a / b
+        self.assertTrue(r.dtype in (ht.float32, ht.float64))
+        np.testing.assert_allclose(r.numpy(), np.ones(5), rtol=1e-6)
+
+    def test_bool_arith_promotes_like_numpy(self):
+        a = ht.array(np.asarray([True, False, True]), split=0)
+        b = ht.array(np.asarray([True, True, False]), split=0)
+        self.assert_array_equal(a + b, np.asarray([True, False, True]) + np.asarray([True, True, False]))
+
+    def test_comparison_yields_bool(self):
+        a = ht.array(np.arange(5, dtype=np.float32), split=0)
+        self.assertEqual((a > 2).dtype, ht.bool)
+        self.assertEqual((a == a).dtype, ht.bool)
+
+
+class TestLogicalBitwiseMatrix(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(207)
+        self.a = rng.integers(0, 16, (13, 7)).astype(np.int32)
+        self.b = rng.integers(0, 16, (13, 7)).astype(np.int32)
+        self.ba = self.a % 2 == 0
+        self.bb = self.b % 3 == 0
+
+    def test_bitwise_split_pairs(self):
+        for name, op in [("and", operator.and_), ("or", operator.or_), ("xor", operator.xor)]:
+            expected = op(self.a, self.b)
+            for sa in _splits(2):
+                for sb in _splits(2):
+                    with self.subTest(op=name, sa=sa, sb=sb):
+                        r = op(ht.array(self.a, split=sa), ht.array(self.b, split=sb))
+                        self.assert_array_equal(r, expected)
+
+    def test_shifts(self):
+        sh = np.asarray([0, 1, 2, 3, 4, 5, 6], np.int32)
+        expected = self.a << sh
+        for s in _splits(2):
+            with self.subTest(split=s):
+                r = ht.array(self.a, split=s) << ht.array(sh)
+                self.assert_array_equal(r, expected)
+        self.assert_array_equal(
+            ht.array(self.a, split=0) >> 2, self.a >> 2
+        )
+
+    def test_logical_ops_on_masks(self):
+        for fn_ht, fn_np in [
+            (ht.logical_and, np.logical_and),
+            (ht.logical_or, np.logical_or),
+            (ht.logical_xor, np.logical_xor),
+        ]:
+            for sa in _splits(2):
+                with self.subTest(fn=fn_np.__name__, sa=sa):
+                    r = fn_ht(ht.array(self.ba, split=sa), ht.array(self.bb, split=sa))
+                    self.assert_array_equal(r, fn_np(self.ba, self.bb))
+
+    def test_invert(self):
+        for s in _splits(2):
+            self.assert_array_equal(~ht.array(self.ba, split=s), ~self.ba)
+            self.assert_array_equal(~ht.array(self.a, split=s), ~self.a)
+
+
+class TestOpChainsAcrossSplits(TestCase):
+    """Expression trees mixing splits — the dominance rule must compose."""
+
+    def test_three_operand_mixed_splits(self):
+        rng = np.random.default_rng(211)
+        a = rng.standard_normal((12, 6)).astype(np.float32)
+        b = rng.standard_normal((12, 6)).astype(np.float32)
+        c = rng.standard_normal((1, 6)).astype(np.float32)
+        expected = (a + b) * c - a / (np.abs(b) + 1)
+        for sa in _splits(2):
+            for sb in _splits(2):
+                with self.subTest(sa=sa, sb=sb):
+                    xa = ht.array(a, split=sa)
+                    xb = ht.array(b, split=sb)
+                    xc = ht.array(c)
+                    r = (xa + xb) * xc - xa / (ht.abs(xb) + 1)
+                    self.assert_array_equal(r, expected, rtol=1e-4)
+
+    def test_reduction_inside_expression(self):
+        rng = np.random.default_rng(213)
+        m = rng.standard_normal((15, 4)).astype(np.float32)
+        expected = (m - m.mean(axis=0)) ** 2 / m.var(axis=0)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(m, split=s)
+                r = (x - ht.mean(x, axis=0)) ** 2 / ht.var(x, axis=0)
+                self.assert_array_equal(r, expected, rtol=1e-3)
+
+    def test_where_mixed_splits(self):
+        rng = np.random.default_rng(217)
+        m = rng.standard_normal((11, 5)).astype(np.float32)
+        expected = np.where(m > 0, m, -m)
+        for sc in _splits(2):
+            for sm in _splits(2):
+                with self.subTest(sc=sc, sm=sm):
+                    cond = ht.array(m, split=sc) > 0
+                    r = ht.where(cond, ht.array(m, split=sm), -ht.array(m, split=sm))
+                    self.assert_array_equal(r, expected, rtol=1e-6)
+
+    def test_clip_and_round_chain(self):
+        v = np.linspace(-4, 4, 33).astype(np.float32)
+        expected = np.round(np.clip(v * 1.5, -3, 3), 1)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                x = ht.array(v, split=s)
+                r = ht.round(ht.clip(x * 1.5, -3, 3), 1)
+                self.assert_array_equal(r, expected, rtol=1e-5)

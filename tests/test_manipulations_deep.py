@@ -1,0 +1,897 @@
+"""Deep manipulations case matrix (reference model:
+heat/core/tests/test_manipulations.py, 3635 LoC).
+
+The reference proves its manipulations by exhausting the case space —
+every op x every split x odd/uneven shapes x dtype edges x error branches —
+and by chaining ops so each consumes the previous op's (possibly
+pad-carrying) distributed output.  This suite rebuilds that matrix for the
+GSPMD layout: every assertion goes through ``TestCase.assert_array_equal``,
+which checks the global result against a NumPy oracle AND each device
+shard against the corresponding ``comm.chunk`` slice, so a result that is
+globally right but physically mislaid still fails.
+
+Shapes are chosen odd on purpose: 13 and 7 and 5 leave uneven tails on the
+8-device mesh, 3 leaves most devices with empty shards, and chained ops
+must keep the zero-pad of the physical layout from leaking into values.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+def _splits(ndim):
+    return [None] + list(range(ndim))
+
+
+class TestConcatenateDeep(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(7)
+        self.a2 = rng.standard_normal((13, 7)).astype(np.float32)
+        self.b2 = rng.standard_normal((5, 7)).astype(np.float32)
+        self.c2 = rng.standard_normal((13, 4)).astype(np.float32)
+
+    def test_axis0_all_split_pairs(self):
+        expected = np.concatenate([self.a2, self.b2], axis=0)
+        for sa in _splits(2):
+            for sb in _splits(2):
+                with self.subTest(sa=sa, sb=sb):
+                    r = ht.concatenate(
+                        [ht.array(self.a2, split=sa), ht.array(self.b2, split=sb)], axis=0
+                    )
+                    self.assert_array_equal(r, expected)
+
+    def test_axis1_all_split_pairs(self):
+        expected = np.concatenate([self.a2, self.c2], axis=1)
+        for sa in _splits(2):
+            for sb in _splits(2):
+                with self.subTest(sa=sa, sb=sb):
+                    r = ht.concatenate(
+                        [ht.array(self.a2, split=sa), ht.array(self.c2, split=sb)], axis=1
+                    )
+                    self.assert_array_equal(r, expected)
+
+    def test_three_way_uneven(self):
+        parts = [self.a2, self.b2, self.a2[:3]]
+        expected = np.concatenate(parts, axis=0)
+        r = ht.concatenate([ht.array(p, split=0) for p in parts], axis=0)
+        self.assert_array_equal(r, expected)
+
+    def test_negative_axis(self):
+        expected = np.concatenate([self.a2, self.c2], axis=-1)
+        r = ht.concatenate(
+            [ht.array(self.a2, split=0), ht.array(self.c2, split=0)], axis=-1
+        )
+        self.assert_array_equal(r, expected)
+
+    def test_dtype_promotion(self):
+        ai = np.arange(12, dtype=np.int32).reshape(3, 4)
+        af = np.arange(12, dtype=np.float32).reshape(3, 4)
+        expected = np.concatenate([ai, af], axis=0)
+        r = ht.concatenate([ht.array(ai, split=0), ht.array(af, split=0)], axis=0)
+        self.assertEqual(r.dtype, ht.float32)
+        self.assert_array_equal(r, expected)
+
+    def test_1d_uneven(self):
+        a = np.arange(13, dtype=np.float32)
+        b = np.arange(3, dtype=np.float32)
+        expected = np.concatenate([a, b])
+        for sa in (None, 0):
+            r = ht.concatenate([ht.array(a, split=sa), ht.array(b, split=sa)], axis=0)
+            self.assert_array_equal(r, expected)
+
+    def test_3d_middle_axis(self):
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((3, 5, 4)).astype(np.float32)
+        y = rng.standard_normal((3, 2, 4)).astype(np.float32)
+        expected = np.concatenate([x, y], axis=1)
+        for s in _splits(3):
+            with self.subTest(split=s):
+                r = ht.concatenate([ht.array(x, split=s), ht.array(y, split=s)], axis=1)
+                self.assert_array_equal(r, expected)
+
+    def test_shape_mismatch_raises(self):
+        with self.assertRaises(ValueError):
+            ht.concatenate(
+                [ht.array(self.a2, split=0), ht.array(self.c2, split=0)], axis=0
+            )
+
+    def test_axis_out_of_range_raises(self):
+        with self.assertRaises(ValueError):
+            ht.concatenate(
+                [ht.array(self.a2, split=0), ht.array(self.b2, split=0)], axis=2
+            )
+
+
+class TestStackFamilyDeep(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(11)
+        self.x = rng.standard_normal((13, 7)).astype(np.float32)
+        self.y = rng.standard_normal((13, 7)).astype(np.float32)
+
+    def test_stack_all_axes_all_splits(self):
+        for axis in (0, 1, 2, -1):
+            expected = np.stack([self.x, self.y], axis=axis)
+            for s in _splits(2):
+                with self.subTest(axis=axis, split=s):
+                    r = ht.stack(
+                        [ht.array(self.x, split=s), ht.array(self.y, split=s)], axis=axis
+                    )
+                    self.assert_array_equal(r, expected)
+
+    def test_vstack_2d(self):
+        expected = np.vstack([self.x, self.y])
+        for s in _splits(2):
+            r = ht.vstack([ht.array(self.x, split=s), ht.array(self.y, split=s)])
+            self.assert_array_equal(r, expected)
+
+    def test_vstack_1d_promotes(self):
+        a, b = np.arange(5.0, dtype=np.float32), np.ones(5, dtype=np.float32)
+        expected = np.vstack([a, b])
+        r = ht.vstack([ht.array(a, split=0), ht.array(b, split=0)])
+        self.assert_array_equal(r, expected)
+
+    def test_hstack_1d_and_2d(self):
+        a1, b1 = np.arange(13.0, dtype=np.float32), np.arange(5.0, dtype=np.float32)
+        self.assert_array_equal(
+            ht.hstack([ht.array(a1, split=0), ht.array(b1, split=0)]),
+            np.hstack([a1, b1]),
+        )
+        self.assert_array_equal(
+            ht.hstack([ht.array(self.x, split=0), ht.array(self.y, split=0)]),
+            np.hstack([self.x, self.y]),
+        )
+
+    def test_dstack(self):
+        expected = np.dstack([self.x, self.y])
+        r = ht.dstack([ht.array(self.x, split=0), ht.array(self.y, split=0)])
+        self.assert_array_equal(r, expected)
+
+    def test_dstack_1d_split_follows_data_axis(self):
+        # a 1-D input's data axis lands on output axis 1 ((1, n, k)): the
+        # split must follow it there, not stay on the size-1 axis 0
+        a = np.arange(13, dtype=np.float32)
+        r = ht.dstack([ht.array(a, split=0), ht.array(a + 10, split=0)])
+        self.assertEqual(r.split, 1)
+        self.assert_array_equal(r, np.dstack([a, a + 10]))
+
+    def test_column_stack_mixed_rank(self):
+        a1 = np.arange(13.0, dtype=np.float32)
+        expected = np.column_stack([a1, self.x])
+        r = ht.column_stack([ht.array(a1, split=0), ht.array(self.x, split=0)])
+        self.assert_array_equal(r, expected)
+
+    def test_row_stack(self):
+        expected = np.vstack([self.x, self.y])
+        r = ht.row_stack([ht.array(self.x, split=1), ht.array(self.y, split=1)])
+        self.assert_array_equal(r, expected)
+
+
+class TestReshapeDeep(TestCase):
+    def setUp(self):
+        self.base = np.arange(2 * 3 * 4 * 5, dtype=np.float32)
+
+    def test_all_target_shapes_all_splits(self):
+        src = self.base.reshape(8, 15)
+        for target in [(120,), (15, 8), (2, 60), (4, 30), (2, 3, 20), (5, 4, 3, 2)]:
+            expected = src.reshape(target)
+            for s in _splits(2):
+                with self.subTest(target=target, split=s):
+                    r = ht.reshape(ht.array(src, split=s), target)
+                    self.assert_array_equal(r, expected)
+
+    def test_minus_one_inference(self):
+        src = self.base.reshape(8, 15)
+        for target, np_target in [((-1,), (120,)), ((6, -1), (6, 20)), ((-1, 5), (24, 5))]:
+            expected = src.reshape(np_target)
+            r = ht.reshape(ht.array(src, split=0), target)
+            self.assert_array_equal(r, expected)
+
+    def test_new_split_matrix(self):
+        # new_split=None keeps the input's split (the documented default);
+        # explicit values pin the result split
+        src = self.base.reshape(12, 10)
+        expected = src.reshape(10, 12)
+        for s in _splits(2):
+            for ns in (0, 1):
+                with self.subTest(split=s, new_split=ns):
+                    r = ht.reshape(ht.array(src, split=s), (10, 12), new_split=ns)
+                    self.assertEqual(r.split, ns)
+                    self.assert_array_equal(r, expected)
+            with self.subTest(split=s, new_split=None):
+                r = ht.reshape(ht.array(src, split=s), (10, 12))
+                self.assertEqual(r.split, s)
+                self.assert_array_equal(r, expected)
+
+    def test_odd_shape_to_odd_shape(self):
+        src = np.arange(91, dtype=np.float32).reshape(13, 7)
+        expected = src.reshape(7, 13)
+        for s in _splits(2):
+            r = ht.reshape(ht.array(src, split=s), (7, 13))
+            self.assert_array_equal(r, expected)
+
+    def test_size_mismatch_raises(self):
+        with self.assertRaises(ValueError):
+            ht.reshape(ht.arange(10, split=0), (3, 4))
+
+    def test_shape_positional_ints(self):
+        src = self.base.reshape(8, 15)
+        r = ht.reshape(ht.array(src, split=0), 4, 30)
+        self.assert_array_equal(r, src.reshape(4, 30))
+
+
+class TestRavelFlattenDeep(TestCase):
+    def test_ravel_all_splits(self):
+        src = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+        for s in _splits(3):
+            with self.subTest(split=s):
+                self.assert_array_equal(ht.ravel(ht.array(src, split=s)), src.ravel())
+
+    def test_flatten_method(self):
+        src = np.arange(91, dtype=np.int32).reshape(13, 7)
+        for s in _splits(2):
+            r = ht.array(src, split=s).flatten()
+            self.assert_array_equal(r, src.flatten())
+
+
+class TestExpandSqueezeDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(35, dtype=np.float32).reshape(5, 7)
+
+    def test_expand_dims_every_position(self):
+        for axis in (0, 1, 2, -1, -2):
+            expected = np.expand_dims(self.src, axis)
+            for s in _splits(2):
+                with self.subTest(axis=axis, split=s):
+                    r = ht.expand_dims(ht.array(self.src, split=s), axis)
+                    self.assert_array_equal(r, expected)
+
+    def test_expand_keeps_split_tracking(self):
+        # inserting an axis before the split dim must shift the split index
+        r = ht.expand_dims(ht.array(self.src, split=1), 0)
+        self.assertEqual(r.split, 2)
+        r = ht.expand_dims(ht.array(self.src, split=1), 2)
+        self.assertEqual(r.split, 1)
+
+    def test_squeeze_all(self):
+        src = self.src.reshape(5, 1, 7, 1)
+        for s in (None, 0, 2):
+            with self.subTest(split=s):
+                r = ht.squeeze(ht.array(src, split=s))
+                self.assert_array_equal(r, src.squeeze())
+
+    def test_squeeze_specific_axis(self):
+        src = self.src.reshape(1, 5, 7)
+        r = ht.squeeze(ht.array(src, split=1), axis=0)
+        self.assertEqual(r.split, 0)
+        self.assert_array_equal(r, src.squeeze(0))
+
+    def test_squeeze_non_unit_raises(self):
+        with self.assertRaises(ValueError):
+            ht.squeeze(ht.array(self.src, split=0), axis=0)
+
+
+class TestRollDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(91, dtype=np.float32).reshape(13, 7)
+
+    def test_roll_flat(self):
+        for shift in (0, 1, 5, -3, 91, 100):
+            expected = np.roll(self.src, shift)
+            for s in _splits(2):
+                with self.subTest(shift=shift, split=s):
+                    r = ht.roll(ht.array(self.src, split=s), shift)
+                    self.assert_array_equal(r, expected)
+
+    def test_roll_axis0(self):
+        for shift in (1, -1, 6, 13, -14):
+            expected = np.roll(self.src, shift, axis=0)
+            for s in _splits(2):
+                with self.subTest(shift=shift, split=s):
+                    r = ht.roll(ht.array(self.src, split=s), shift, axis=0)
+                    self.assert_array_equal(r, expected)
+
+    def test_roll_axis1(self):
+        expected = np.roll(self.src, 3, axis=1)
+        for s in _splits(2):
+            r = ht.roll(ht.array(self.src, split=s), 3, axis=1)
+            self.assert_array_equal(r, expected)
+
+    def test_roll_tuple_shifts(self):
+        expected = np.roll(self.src, (2, -1), axis=(0, 1))
+        for s in _splits(2):
+            r = ht.roll(ht.array(self.src, split=s), (2, -1), axis=(0, 1))
+            self.assert_array_equal(r, expected)
+
+    def test_roll_on_empty_sharded_dim(self):
+        # 3 rows over 8 devices: most shards are empty
+        src = np.arange(21, dtype=np.float32).reshape(3, 7)
+        r = ht.roll(ht.array(src, split=0), 1, axis=0)
+        self.assert_array_equal(r, np.roll(src, 1, axis=0))
+
+
+class TestFlipRotDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+
+    def test_flip_every_axis(self):
+        for axis in (0, 1, 2, (0, 1), (0, 2), None):
+            expected = np.flip(self.src, axis)
+            for s in _splits(3):
+                with self.subTest(axis=axis, split=s):
+                    r = ht.flip(ht.array(self.src, split=s), axis)
+                    self.assert_array_equal(r, expected)
+
+    def test_flip_uneven_split_dim(self):
+        src = np.arange(13, dtype=np.int32)
+        r = ht.flip(ht.array(src, split=0), 0)
+        self.assert_array_equal(r, np.flip(src))
+
+    def test_fliplr_flipud(self):
+        src2 = self.src[:, :, 0]
+        for s in _splits(2):
+            self.assert_array_equal(ht.fliplr(ht.array(src2, split=s)), np.fliplr(src2))
+            self.assert_array_equal(ht.flipud(ht.array(src2, split=s)), np.flipud(src2))
+
+    def test_rot90_all_k(self):
+        src2 = np.arange(35, dtype=np.float32).reshape(5, 7)
+        for k in (0, 1, 2, 3, 4, -1):
+            expected = np.rot90(src2, k)
+            for s in _splits(2):
+                with self.subTest(k=k, split=s):
+                    r = ht.rot90(ht.array(src2, split=s), k)
+                    self.assert_array_equal(r, expected)
+
+    def test_rot90_3d_axes(self):
+        expected = np.rot90(self.src, 1, axes=(1, 2))
+        r = ht.rot90(ht.array(self.src, split=0), 1, axes=(1, 2))
+        self.assert_array_equal(r, expected)
+
+
+class TestTransposeFamilyDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(105, dtype=np.float32).reshape(3, 5, 7)
+
+    def test_moveaxis_matrix(self):
+        for (src_ax, dst_ax) in [(0, 2), (2, 0), (1, 0), (0, -1), (-1, 0)]:
+            expected = np.moveaxis(self.src, src_ax, dst_ax)
+            for s in _splits(3):
+                with self.subTest(move=(src_ax, dst_ax), split=s):
+                    r = ht.moveaxis(ht.array(self.src, split=s), src_ax, dst_ax)
+                    self.assert_array_equal(r, expected)
+
+    def test_swapaxes_matrix(self):
+        for (a1, a2) in [(0, 1), (0, 2), (1, 2), (-1, 0)]:
+            expected = np.swapaxes(self.src, a1, a2)
+            for s in _splits(3):
+                with self.subTest(axes=(a1, a2), split=s):
+                    r = ht.swapaxes(ht.array(self.src, split=s), a1, a2)
+                    self.assert_array_equal(r, expected)
+
+    def test_transpose_tracks_split(self):
+        x = ht.array(self.src, split=2)
+        r = x.transpose((2, 0, 1))
+        self.assertEqual(r.split, 0)
+        self.assert_array_equal(r, self.src.transpose(2, 0, 1))
+
+
+class TestPadDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(35, dtype=np.float32).reshape(5, 7)
+
+    def test_constant_pad_widths(self):
+        for pw in [1, (1, 2), ((1, 2), (0, 3)), ((0, 0), (2, 1))]:
+            expected = np.pad(self.src, pw, constant_values=0)
+            for s in _splits(2):
+                with self.subTest(pw=pw, split=s):
+                    r = ht.pad(ht.array(self.src, split=s), pw)
+                    self.assert_array_equal(r, expected)
+
+    def test_constant_value(self):
+        expected = np.pad(self.src, 2, constant_values=-1.5)
+        r = ht.pad(ht.array(self.src, split=0), 2, constant_values=-1.5)
+        self.assert_array_equal(r, expected)
+
+    def test_pad_on_split_axis_uneven(self):
+        src = np.arange(13, dtype=np.float32)
+        expected = np.pad(src, (3, 4), constant_values=9.0)
+        r = ht.pad(ht.array(src, split=0), (3, 4), constant_values=9.0)
+        self.assert_array_equal(r, expected)
+
+
+class TestRepeatTileDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(15, dtype=np.float32).reshape(3, 5)
+
+    def test_repeat_flat(self):
+        for reps in (1, 2, 3):
+            expected = np.repeat(self.src, reps)
+            for s in _splits(2):
+                with self.subTest(reps=reps, split=s):
+                    r = ht.repeat(ht.array(self.src, split=s), reps)
+                    self.assert_array_equal(r, expected)
+
+    def test_repeat_axis(self):
+        for axis in (0, 1):
+            expected = np.repeat(self.src, 3, axis=axis)
+            for s in _splits(2):
+                with self.subTest(axis=axis, split=s):
+                    r = ht.repeat(ht.array(self.src, split=s), 3, axis=axis)
+                    self.assert_array_equal(r, expected)
+
+    def test_tile_matrix(self):
+        for reps in [2, (2, 1), (1, 3), (2, 2), (2, 1, 3)]:
+            expected = np.tile(self.src, reps)
+            for s in _splits(2):
+                with self.subTest(reps=reps, split=s):
+                    r = ht.tile(ht.array(self.src, split=s), reps)
+                    self.assert_array_equal(r, expected)
+
+    def test_tile_1d_uneven(self):
+        src = np.arange(13, dtype=np.int32)
+        r = ht.tile(ht.array(src, split=0), 3)
+        self.assert_array_equal(r, np.tile(src, 3))
+
+
+class TestSplitFamilyDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(120, dtype=np.float32).reshape(12, 10)
+
+    def _check_parts(self, got, expected):
+        self.assertEqual(len(got), len(expected))
+        for g, e in zip(got, expected):
+            self.assert_array_equal(g, e)
+
+    def test_split_sections_axis0(self):
+        for s in _splits(2):
+            with self.subTest(split=s):
+                self._check_parts(
+                    ht.split(ht.array(self.src, split=s), 3, axis=0),
+                    np.split(self.src, 3, axis=0),
+                )
+
+    def test_split_sections_axis1(self):
+        for s in _splits(2):
+            with self.subTest(split=s):
+                self._check_parts(
+                    ht.split(ht.array(self.src, split=s), 5, axis=1),
+                    np.split(self.src, 5, axis=1),
+                )
+
+    def test_split_index_list(self):
+        idx = [2, 5, 9]
+        for s in _splits(2):
+            with self.subTest(split=s):
+                self._check_parts(
+                    ht.split(ht.array(self.src, split=s), idx, axis=0),
+                    np.split(self.src, idx, axis=0),
+                )
+
+    def test_split_uneven_sections_raises(self):
+        with self.assertRaises(ValueError):
+            ht.split(ht.array(self.src, split=0), 7, axis=0)
+
+    def test_vsplit_hsplit_dsplit(self):
+        self._check_parts(
+            ht.vsplit(ht.array(self.src, split=0), 4), np.vsplit(self.src, 4)
+        )
+        self._check_parts(
+            ht.hsplit(ht.array(self.src, split=0), 2), np.hsplit(self.src, 2)
+        )
+        src3 = self.src.reshape(4, 5, 6)
+        self._check_parts(
+            ht.dsplit(ht.array(src3, split=0), 3), np.dsplit(src3, 3)
+        )
+
+
+class TestBroadcastDeep(TestCase):
+    def test_broadcast_to_shapes(self):
+        src = np.arange(7, dtype=np.float32)
+        for target in [(3, 7), (2, 3, 7), (1, 7)]:
+            expected = np.broadcast_to(src, target)
+            r = ht.broadcast_to(ht.array(src), target)
+            self.assert_array_equal(r, expected)
+
+    def test_broadcast_to_split_column(self):
+        src = np.arange(13, dtype=np.float32).reshape(13, 1)
+        expected = np.broadcast_to(src, (13, 5))
+        r = ht.broadcast_to(ht.array(src, split=0), (13, 5))
+        self.assert_array_equal(r, expected)
+
+    def test_broadcast_arrays(self):
+        a = np.arange(5, dtype=np.float32).reshape(5, 1)
+        b = np.arange(3, dtype=np.float32)
+        ea, eb = np.broadcast_arrays(a, b)
+        ra, rb = ht.broadcast_arrays(ht.array(a, split=0), ht.array(b))
+        self.assert_array_equal(ra, ea)
+        self.assert_array_equal(rb, eb)
+
+    def test_broadcast_incompatible_raises(self):
+        with self.assertRaises(ValueError):
+            ht.broadcast_to(ht.arange(5), (3, 4))
+
+
+class TestDiagDeep(TestCase):
+    def test_diag_extract_offsets(self):
+        src = np.arange(49, dtype=np.float32).reshape(7, 7)
+        for off in (0, 1, 2, -1, -3):
+            expected = np.diag(src, off)
+            for s in _splits(2):
+                with self.subTest(offset=off, split=s):
+                    r = ht.diag(ht.array(src, split=s), off)
+                    self.assert_array_equal(r, expected)
+
+    def test_diag_construct(self):
+        v = np.arange(9, dtype=np.float32)
+        for off in (0, 1, -2):
+            expected = np.diag(v, off)
+            for s in (None, 0):
+                with self.subTest(offset=off, split=s):
+                    r = ht.diag(ht.array(v, split=s), off)
+                    self.assert_array_equal(r, expected)
+
+    def test_diagonal_3d(self):
+        src = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+        for (d1, d2) in [(0, 1), (1, 2), (0, 2)]:
+            expected = np.diagonal(src, 0, d1, d2)
+            r = ht.diagonal(ht.array(src, split=None), 0, d1, d2)
+            self.assert_array_equal(r, expected)
+
+    def test_diagonal_rectangular(self):
+        src = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for off in (0, 3, -2):
+            expected = np.diagonal(src, off)
+            for s in _splits(2):
+                with self.subTest(offset=off, split=s):
+                    r = ht.diagonal(ht.array(src, split=s), off)
+                    self.assert_array_equal(r, expected)
+
+
+class TestSortDeep(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(23)
+        self.flat = rng.standard_normal(29).astype(np.float32)
+        self.mat = rng.standard_normal((13, 7)).astype(np.float32)
+
+    def test_sort_1d_every_split(self):
+        expected = np.sort(self.flat)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                v, _ = ht.sort(ht.array(self.flat, split=s), axis=0)
+                self.assert_array_equal(v, expected)
+
+    def test_sort_indices_reconstruct(self):
+        # the returned indices must gather the input into the sorted order
+        for s in (None, 0):
+            with self.subTest(split=s):
+                v, idx = ht.sort(ht.array(self.flat, split=s), axis=0)
+                np.testing.assert_allclose(
+                    self.flat[idx.numpy()], np.sort(self.flat), rtol=1e-6
+                )
+
+    def test_sort_descending(self):
+        expected = -np.sort(-self.flat)
+        for s in (None, 0):
+            v, _ = ht.sort(ht.array(self.flat, split=s), axis=0, descending=True)
+            self.assert_array_equal(v, expected)
+
+    def test_sort_2d_both_axes_all_splits(self):
+        for axis in (0, 1, -1):
+            expected = np.sort(self.mat, axis=axis)
+            for s in _splits(2):
+                with self.subTest(axis=axis, split=s):
+                    v, _ = ht.sort(ht.array(self.mat, split=s), axis=axis)
+                    self.assert_array_equal(v, expected)
+
+    def test_sort_with_duplicates(self):
+        data = np.array([3, 1, 3, 2, 1, 3, 0, 2, 2, 1, 3], dtype=np.int32)
+        v, _ = ht.sort(ht.array(data, split=0), axis=0)
+        self.assert_array_equal(v, np.sort(data))
+
+    def test_sort_nan_to_end(self):
+        data = self.flat.copy()
+        data[[2, 7, 19]] = np.nan
+        expected = np.sort(data)  # numpy puts NaN last
+        for s in (None, 0):
+            with self.subTest(split=s):
+                v, _ = ht.sort(ht.array(data, split=s), axis=0)
+                got = v.numpy()
+                np.testing.assert_array_equal(np.isnan(got), np.isnan(expected))
+                np.testing.assert_allclose(
+                    got[~np.isnan(got)], expected[~np.isnan(expected)], rtol=1e-6
+                )
+
+    def test_sort_signed_zero(self):
+        data = np.array([0.0, -0.0, 1.0, -1.0, 0.0, -0.0], dtype=np.float32)
+        v, _ = ht.sort(ht.array(data, split=0), axis=0)
+        got = v.numpy()
+        np.testing.assert_array_equal(got, np.sort(data))
+        # -0.0 sorts before +0.0 (totalorder semantics of the local path)
+        np.testing.assert_array_equal(
+            np.signbit(got), np.signbit(np.sort(data))
+        )
+
+    def test_sort_empty_tail_shards(self):
+        data = np.array([5.0, 1.0, 3.0], dtype=np.float32)  # 3 elems / 8 devs
+        v, _ = ht.sort(ht.array(data, split=0), axis=0)
+        self.assert_array_equal(v, np.sort(data))
+
+
+class TestTopkDeep(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(29)
+        self.flat = rng.permutation(np.arange(37, dtype=np.float32))
+        self.mat = rng.standard_normal((9, 11)).astype(np.float32)
+
+    def test_topk_1d_k_sweep(self):
+        for k in (1, 3, 17, 37):
+            expected = np.sort(self.flat)[::-1][:k]
+            for s in (None, 0):
+                with self.subTest(k=k, split=s):
+                    v, idx = ht.topk(ht.array(self.flat, split=s), k)
+                    np.testing.assert_allclose(v.numpy(), expected, rtol=1e-6)
+                    np.testing.assert_allclose(
+                        self.flat[idx.numpy()], expected, rtol=1e-6
+                    )
+
+    def test_topk_smallest(self):
+        expected = np.sort(self.flat)[:5]
+        for s in (None, 0):
+            v, _ = ht.topk(ht.array(self.flat, split=s), 5, largest=False)
+            np.testing.assert_allclose(v.numpy(), expected, rtol=1e-6)
+
+    def test_topk_2d_dims(self):
+        for dim in (0, 1, -1):
+            k = 4
+            expected = -np.sort(-self.mat, axis=dim)
+            take = [slice(None)] * 2
+            take[dim if dim >= 0 else 2 + dim] = slice(0, k)
+            expected = expected[tuple(take)]
+            for s in _splits(2):
+                with self.subTest(dim=dim, split=s):
+                    v, _ = ht.topk(ht.array(self.mat, split=s), k, dim=dim)
+                    np.testing.assert_allclose(v.numpy(), expected, rtol=1e-6)
+
+    def test_topk_k_too_large_raises(self):
+        with self.assertRaises(ValueError):
+            ht.topk(ht.array(self.flat, split=0), 38)
+
+
+class TestUniqueDeep(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(31)
+        self.data = rng.integers(0, 12, size=41).astype(np.float32)
+
+    def test_unique_sorted_every_split(self):
+        expected = np.unique(self.data)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                u = ht.unique(ht.array(self.data, split=s), sorted=True)
+                np.testing.assert_allclose(np.sort(u.numpy()), expected, rtol=1e-6)
+
+    def test_unique_with_nan_collapses(self):
+        data = self.data.copy()
+        data[[1, 5, 9]] = np.nan
+        expected = np.unique(data)  # one NaN slot at the end
+        for s in (None, 0):
+            with self.subTest(split=s):
+                u = np.sort(ht.unique(ht.array(data, split=s), sorted=True).numpy())
+                self.assertEqual(np.isnan(u).sum(), 1)
+                np.testing.assert_allclose(
+                    u[~np.isnan(u)], expected[~np.isnan(expected)], rtol=1e-6
+                )
+
+    def test_unique_return_inverse_reconstructs(self):
+        for s in (None, 0):
+            with self.subTest(split=s):
+                u, inv = ht.unique(
+                    ht.array(self.data, split=s), sorted=True, return_inverse=True
+                )
+                np.testing.assert_allclose(
+                    u.numpy()[inv.numpy()], self.data, rtol=1e-6
+                )
+
+    def test_unique_inverse_keeps_split(self):
+        u, inv = ht.unique(ht.array(self.data, split=0), sorted=True, return_inverse=True)
+        self.assertEqual(inv.split, 0)
+        self.assertEqual(tuple(inv.shape), self.data.shape)
+
+    def test_unique_inverse_with_nans(self):
+        data = self.data.copy()
+        data[[0, 7, 13, 20]] = np.nan
+        for s in (None, 0):
+            with self.subTest(split=s):
+                u, inv = ht.unique(
+                    ht.array(data, split=s), sorted=True, return_inverse=True
+                )
+                un, invn = u.numpy(), inv.numpy()
+                self.assertTrue((invn >= 0).all() and (invn < len(un)).all())
+                recon = un[invn]
+                np.testing.assert_array_equal(np.isnan(recon), np.isnan(data))
+                np.testing.assert_allclose(
+                    recon[~np.isnan(data)], data[~np.isnan(data)], rtol=1e-6
+                )
+
+    def test_unique_all_duplicates(self):
+        data = np.full(19, 4.0, dtype=np.float32)
+        u = ht.unique(ht.array(data, split=0), sorted=True)
+        np.testing.assert_allclose(u.numpy(), [4.0])
+
+    def test_unique_all_distinct(self):
+        data = np.arange(23, dtype=np.float32)
+        u = ht.unique(ht.array(data, split=0), sorted=True)
+        np.testing.assert_allclose(np.sort(u.numpy()), data)
+
+    def test_unique_2d_flattens(self):
+        data = self.data[:40].reshape(8, 5)
+        u = ht.unique(ht.array(data, split=0), sorted=True)
+        np.testing.assert_allclose(np.sort(u.numpy().ravel()), np.unique(data))
+
+
+class TestResplitMatrixDeep(TestCase):
+    def setUp(self):
+        self.src = np.arange(91, dtype=np.float32).reshape(13, 7)
+
+    def test_all_resplit_pairs(self):
+        for s_from in _splits(2):
+            for s_to in _splits(2):
+                with self.subTest(s_from=s_from, s_to=s_to):
+                    x = ht.array(self.src, split=s_from)
+                    r = ht.resplit(x, s_to)
+                    self.assertEqual(r.split, s_to)
+                    self.assert_array_equal(r, self.src)
+
+    def test_resplit_3d_chain(self):
+        src = np.arange(105, dtype=np.float32).reshape(3, 5, 7)
+        x = ht.array(src, split=0)
+        for s_to in (1, 2, None, 0, 2):
+            x = ht.resplit(x, s_to)
+            self.assert_array_equal(x, src)
+
+    def test_resplit_inplace(self):
+        x = ht.array(self.src, split=0)
+        x.resplit_(1)
+        self.assertEqual(x.split, 1)
+        self.assert_array_equal(x, self.src)
+
+    def test_balance_noop_canonical(self):
+        # canonical GSPMD layout is always balanced; balance must be identity
+        x = ht.array(self.src, split=0)
+        b = ht.balance(x)
+        self.assertTrue(bool(x.is_balanced()))
+        self.assert_array_equal(b, self.src)
+
+
+class TestChains(TestCase):
+    """Op chains: each op consumes the previous op's distributed output —
+    the reference's deepest coverage pattern (pad-carrying layouts must
+    stay consistent through arbitrary op sequences)."""
+
+    def test_concat_sort_unique_chain(self):
+        rng = np.random.default_rng(41)
+        a = rng.integers(0, 9, 17).astype(np.float32)
+        b = rng.integers(0, 9, 14).astype(np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                x = ht.concatenate([ht.array(a, split=s), ht.array(b, split=s)], axis=0)
+                v, _ = ht.sort(x, axis=0)
+                u = ht.unique(v, sorted=True)
+                np.testing.assert_allclose(
+                    np.sort(u.numpy()), np.unique(np.concatenate([a, b])), rtol=1e-6
+                )
+
+    def test_reshape_roll_flip_chain(self):
+        src = np.arange(120, dtype=np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                x = ht.reshape(x, (12, 10))
+                x = ht.roll(x, 3, axis=0)
+                x = ht.flip(x, 1)
+                expected = np.flip(np.roll(src.reshape(12, 10), 3, axis=0), 1)
+                self.assert_array_equal(x, expected)
+
+    def test_pad_concat_reshape_chain(self):
+        src = np.arange(35, dtype=np.float32).reshape(5, 7)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                p = ht.pad(x, ((1, 2), (0, 0)), constant_values=-1)
+                c = ht.concatenate([p, p], axis=1)
+                r = ht.reshape(c, (-1,))
+                expected = np.pad(src, ((1, 2), (0, 0)), constant_values=-1)
+                expected = np.concatenate([expected, expected], axis=1).reshape(-1)
+                self.assert_array_equal(r, expected)
+
+    def test_transpose_sort_topk_chain(self):
+        rng = np.random.default_rng(43)
+        src = rng.standard_normal((9, 13)).astype(np.float32)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                t = ht.swapaxes(x, 0, 1)  # (13, 9)
+                v, _ = ht.sort(t, axis=0)
+                top, _ = ht.topk(v, 3, dim=0)
+                expected = -np.sort(-np.sort(src.T, axis=0), axis=0)[:3]
+                np.testing.assert_allclose(top.numpy(), expected, rtol=1e-5)
+
+    def test_squeeze_expand_stack_chain(self):
+        src = np.arange(26, dtype=np.float32).reshape(13, 1, 2)
+        for s in (None, 0, 2):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                sq = ht.squeeze(x, axis=1)           # (13, 2)
+                ex = ht.expand_dims(sq, 0)           # (1, 13, 2)
+                st = ht.concatenate([ex, ex], axis=0)  # (2, 13, 2)
+                expected = np.concatenate(
+                    [src.squeeze(1)[None], src.squeeze(1)[None]], axis=0
+                )
+                self.assert_array_equal(st, expected)
+
+    def test_resplit_interleaved_chain(self):
+        # resplits interleaved with compute ops: the physical relayouts
+        # must compose with pad-carrying uneven shapes
+        src = np.arange(91, dtype=np.float32).reshape(13, 7)
+        x = ht.array(src, split=0)
+        x = ht.resplit(x, 1)
+        x = ht.roll(x, 2, axis=0)
+        x = ht.resplit(x, 0)
+        x = ht.flip(x, 0)
+        x = ht.resplit(x, None)
+        expected = np.flip(np.roll(src, 2, axis=0), 0)
+        self.assert_array_equal(x, expected)
+
+    def test_arith_manip_interleave(self):
+        # chains through _operations: manip output feeds arithmetic and back
+        src = np.arange(60, dtype=np.float32).reshape(12, 5)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                y = ht.reshape(x * 2.0, (5, 12))
+                z = ht.roll(y + 1.0, 1, axis=1)
+                w = z - ht.flip(z, 0)
+                expected = np.roll((src * 2).reshape(5, 12) + 1, 1, axis=1)
+                expected = expected - np.flip(expected, 0)
+                self.assert_array_equal(w, expected)
+
+    def test_unique_of_tiled_roll(self):
+        src = np.arange(7, dtype=np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                t = ht.tile(x, 3)
+                r = ht.roll(t, 5)
+                u = ht.unique(r, sorted=True)
+                np.testing.assert_allclose(np.sort(u.numpy()), src, rtol=1e-6)
+
+    def test_diag_of_reshaped_sorted(self):
+        rng = np.random.default_rng(47)
+        src = rng.standard_normal(49).astype(np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                v, _ = ht.sort(x, axis=0)
+                m = ht.reshape(v, (7, 7))
+                d = ht.diag(m)
+                expected = np.diag(np.sort(src).reshape(7, 7))
+                self.assert_array_equal(d, expected)
+
+    def test_long_mixed_chain_odd_shapes(self):
+        rng = np.random.default_rng(53)
+        src = rng.standard_normal((11, 6)).astype(np.float32)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(src, split=s)
+                x = ht.pad(x, ((0, 1), (1, 0)), constant_values=0.5)   # (12, 7)
+                x = ht.swapaxes(x, 0, 1)                                # (7, 12)
+                x = ht.reshape(x, (4, 21))
+                x = ht.roll(x, (1, -2), axis=(0, 1))
+                x = ht.flip(x, 0)
+                v, _ = ht.sort(x, axis=1)
+                e = np.pad(src, ((0, 1), (1, 0)), constant_values=0.5).T
+                e = e.reshape(4, 21)
+                e = np.roll(e, (1, -2), axis=(0, 1))
+                e = np.flip(e, 0)
+                e = np.sort(e, axis=1)
+                self.assert_array_equal(v, e, rtol=1e-5)

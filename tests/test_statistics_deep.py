@@ -1,0 +1,315 @@
+"""Statistics case matrix (reference model: heat/core/tests/
+test_statistics.py — every reduction x axis x split x keepdims x dtype,
+plus the quantile/histogram family).
+
+NumPy is the oracle throughout; distributed assertions go through
+``assert_array_equal``'s per-shard check.  The quantile family runs
+through the distributed sort on split inputs, so NaN propagation and
+vector-q cases double as end-to-end sort coverage.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+def _splits(ndim):
+    return [None] + list(range(ndim))
+
+
+class TestReductionMatrix(TestCase):
+    """mean/var/std/min/max over every axis x split x keepdims."""
+
+    def setUp(self):
+        rng = np.random.default_rng(71)
+        self.m = rng.standard_normal((13, 7)).astype(np.float32)
+        self.t = rng.standard_normal((4, 5, 6)).astype(np.float32)
+
+    def _sweep(self, ht_fn, np_fn, data, axes, rtol=1e-4, **kw):
+        for axis in axes:
+            for keepdims in (False, True):
+                expected = np_fn(data, axis=axis, keepdims=keepdims, **kw)
+                for s in _splits(data.ndim):
+                    with self.subTest(axis=axis, keepdims=keepdims, split=s):
+                        x = ht.array(data, split=s)
+                        r = ht_fn(x, axis=axis, keepdims=keepdims)
+                        if np.isscalar(expected) or expected.ndim == 0:
+                            np.testing.assert_allclose(
+                                float(r.numpy()), expected, rtol=rtol
+                            )
+                        else:
+                            self.assert_array_equal(r, expected, rtol=rtol)
+
+    def test_mean_matrix_2d(self):
+        self._sweep(ht.mean, np.mean, self.m, [None, 0, 1, (0, 1)])
+
+    def test_mean_matrix_3d(self):
+        self._sweep(ht.mean, np.mean, self.t, [None, 0, 1, 2, (0, 2), (1, 2)])
+
+    def test_var_matrix_2d(self):
+        self._sweep(ht.var, np.var, self.m, [None, 0, 1])
+
+    def test_var_ddof1(self):
+        for s in _splits(2):
+            r = ht.var(ht.array(self.m, split=s), axis=0, ddof=1)
+            self.assert_array_equal(r, np.var(self.m, axis=0, ddof=1), rtol=1e-4)
+
+    def test_std_matrix(self):
+        self._sweep(ht.std, np.std, self.m, [None, 0, 1])
+
+    def test_min_max_matrix(self):
+        self._sweep(ht.min, np.min, self.m, [None, 0, 1])
+        self._sweep(ht.max, np.max, self.m, [None, 0, 1])
+        self._sweep(ht.min, np.min, self.t, [0, 2])
+        self._sweep(ht.max, np.max, self.t, [1, (0, 1)])
+
+    def test_sum_prod_matrix(self):
+        self._sweep(ht.sum, np.sum, self.m, [None, 0, 1, (0, 1)])
+        small = (self.m[:4, :4] * 0.5).astype(np.float32)
+        self._sweep(ht.prod, np.prod, small, [None, 0, 1], rtol=1e-3)
+
+    def test_int_dtype_reductions(self):
+        data = np.arange(35, dtype=np.int32).reshape(5, 7)
+        for s in _splits(2):
+            self.assertEqual(int(ht.sum(ht.array(data, split=s)).numpy()), data.sum())
+            self.assertEqual(int(ht.max(ht.array(data, split=s)).numpy()), data.max())
+            self.assertEqual(int(ht.min(ht.array(data, split=s)).numpy()), data.min())
+
+    def test_empty_axis_reduction_on_sharded(self):
+        # 3 rows over 8 devices: reductions must ignore pad shards
+        data = np.arange(9, dtype=np.float32).reshape(3, 3)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                np.testing.assert_allclose(
+                    float(ht.sum(ht.array(data, split=s)).numpy()), data.sum()
+                )
+                np.testing.assert_allclose(
+                    float(ht.min(ht.array(data, split=s)).numpy()), data.min()
+                )
+
+
+class TestArgReductions(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(73)
+        self.m = rng.permutation(91).reshape(13, 7).astype(np.float32)
+
+    def test_argmax_argmin_matrix(self):
+        for fn_ht, fn_np in [(ht.argmax, np.argmax), (ht.argmin, np.argmin)]:
+            for axis in (None, 0, 1):
+                expected = fn_np(self.m, axis=axis)
+                for s in _splits(2):
+                    with self.subTest(fn=fn_np.__name__, axis=axis, split=s):
+                        r = fn_ht(ht.array(self.m, split=s), axis=axis)
+                        got = r.numpy()
+                        if axis is None:
+                            self.assertEqual(int(got), expected)
+                        else:
+                            np.testing.assert_array_equal(
+                                got.astype(np.int64), expected
+                            )
+
+    def test_argmax_ties_take_first(self):
+        data = np.asarray([[1, 3, 3], [3, 1, 3]], np.float32)
+        for s in _splits(2):
+            np.testing.assert_array_equal(
+                ht.argmax(ht.array(data, split=s), axis=1).numpy().astype(np.int64),
+                np.argmax(data, axis=1),
+            )
+
+
+class TestQuantileFamily(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(79)
+        self.v = rng.standard_normal(101).astype(np.float32)
+        self.m = rng.standard_normal((12, 9)).astype(np.float32)
+
+    def test_median_matrix(self):
+        for axis in (None, 0, 1):
+            expected = np.median(self.m, axis=axis)
+            for s in _splits(2):
+                with self.subTest(axis=axis, split=s):
+                    r = ht.median(ht.array(self.m, split=s), axis=axis)
+                    got = r.numpy()
+                    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    def test_percentile_scalar_q(self):
+        for q in (0, 25, 50, 75, 100):
+            expected = np.percentile(self.v, q)
+            for s in (None, 0):
+                with self.subTest(q=q, split=s):
+                    r = ht.percentile(ht.array(self.v, split=s), q)
+                    np.testing.assert_allclose(
+                        float(r.numpy()), expected, rtol=1e-5, atol=1e-6
+                    )
+
+    def test_percentile_vector_q(self):
+        q = [10, 50, 90]
+        expected = np.percentile(self.v, q)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                r = ht.percentile(ht.array(self.v, split=s), q)
+                np.testing.assert_allclose(r.numpy(), expected, rtol=1e-5, atol=1e-6)
+
+    def test_median_with_nan_propagates(self):
+        data = self.v.copy()
+        data[7] = np.nan
+        for s in (None, 0):
+            with self.subTest(split=s):
+                r = ht.median(ht.array(data, split=s))
+                self.assertTrue(np.isnan(float(r.numpy())))
+
+    def test_median_odd_even_lengths(self):
+        for n in (5, 6, 13, 16):
+            data = np.random.default_rng(n).standard_normal(n).astype(np.float32)
+            for s in (None, 0):
+                with self.subTest(n=n, split=s):
+                    r = ht.median(ht.array(data, split=s))
+                    np.testing.assert_allclose(
+                        float(r.numpy()), np.median(data), rtol=1e-5, atol=1e-6
+                    )
+
+
+class TestCovCorr(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(83)
+        self.m = rng.standard_normal((6, 40)).astype(np.float32)
+
+    def test_cov_matrix(self):
+        expected = np.cov(self.m)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                r = ht.cov(ht.array(self.m, split=s))
+                self.assert_array_equal(r, expected.astype(np.float32), rtol=1e-3)
+
+    def test_cov_ddof0(self):
+        expected = np.cov(self.m, ddof=0)
+        r = ht.cov(ht.array(self.m, split=1), ddof=0)
+        self.assert_array_equal(r, expected.astype(np.float32), rtol=1e-3)
+
+    def test_average_weighted(self):
+        w = np.abs(np.random.default_rng(5).standard_normal(6)).astype(np.float32)
+        expected = np.average(self.m, axis=0, weights=w)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                r = ht.average(
+                    ht.array(self.m, split=s), axis=0, weights=ht.array(w)
+                )
+                self.assert_array_equal(r, expected, rtol=1e-4)
+
+    def test_skew_kurtosis_match_scipy_def(self):
+        # ht defaults to unbiased=True (the reference's convention,
+        # statistics.py:1679) = scipy's bias=False
+        from scipy import stats as sps
+
+        v = np.random.default_rng(11).standard_normal(500).astype(np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                np.testing.assert_allclose(
+                    float(ht.skew(ht.array(v, split=s)).numpy()),
+                    sps.skew(v, bias=False), rtol=1e-3, atol=1e-4,
+                )
+                np.testing.assert_allclose(
+                    float(ht.kurtosis(ht.array(v, split=s)).numpy()),
+                    sps.kurtosis(v, bias=False), rtol=1e-3, atol=1e-4,
+                )
+                np.testing.assert_allclose(
+                    float(ht.skew(ht.array(v, split=s), unbiased=False).numpy()),
+                    sps.skew(v, bias=True), rtol=1e-3, atol=1e-4,
+                )
+
+
+class TestHistogramFamily(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(89)
+        self.v = rng.standard_normal(200).astype(np.float32)
+
+    def test_histogram_default_bins(self):
+        for s in (None, 0):
+            with self.subTest(split=s):
+                hist, edges = ht.histogram(ht.array(self.v, split=s))
+                want_hist, want_edges = np.histogram(self.v)
+                np.testing.assert_array_equal(
+                    hist.numpy().astype(np.int64), want_hist
+                )
+                np.testing.assert_allclose(edges.numpy(), want_edges, rtol=1e-5)
+
+    def test_histogram_explicit_range(self):
+        hist, edges = ht.histogram(ht.array(self.v, split=0), bins=20, range=(-2, 2))
+        want_hist, want_edges = np.histogram(self.v, bins=20, range=(-2, 2))
+        np.testing.assert_array_equal(hist.numpy().astype(np.int64), want_hist)
+        np.testing.assert_allclose(edges.numpy(), want_edges, rtol=1e-5, atol=1e-6)
+
+    def test_bincount(self):
+        data = np.random.default_rng(3).integers(0, 9, 100).astype(np.int32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                r = ht.bincount(ht.array(data, split=s))
+                np.testing.assert_array_equal(
+                    r.numpy().astype(np.int64), np.bincount(data)
+                )
+
+    def test_bincount_weights(self):
+        data = np.random.default_rng(4).integers(0, 5, 50).astype(np.int32)
+        w = np.random.default_rng(5).standard_normal(50).astype(np.float32)
+        r = ht.bincount(ht.array(data, split=0), weights=ht.array(w, split=0))
+        np.testing.assert_allclose(
+            r.numpy(), np.bincount(data, weights=w), rtol=1e-4, atol=1e-5
+        )
+
+    def test_digitize_bucketize(self):
+        bins = np.asarray([-1.0, 0.0, 1.0], np.float32)
+        for right in (False, True):
+            expected = np.digitize(self.v, bins, right=right)
+            for s in (None, 0):
+                with self.subTest(right=right, split=s):
+                    r = ht.digitize(
+                        ht.array(self.v, split=s), ht.array(bins), right=right
+                    )
+                    np.testing.assert_array_equal(
+                        r.numpy().astype(np.int64), expected
+                    )
+
+
+class TestStatChains(TestCase):
+    """Statistics over manipulated distributed inputs — reductions must be
+    correct on op outputs that carry non-trivial physical layouts."""
+
+    def test_moments_of_concatenated(self):
+        rng = np.random.default_rng(97)
+        a = rng.standard_normal((9, 5)).astype(np.float32)
+        b = rng.standard_normal((6, 5)).astype(np.float32)
+        cat = np.concatenate([a, b])
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.concatenate([ht.array(a, split=s), ht.array(b, split=s)], axis=0)
+                self.assert_array_equal(ht.mean(x, axis=0), cat.mean(axis=0), rtol=1e-4)
+                self.assert_array_equal(ht.var(x, axis=0), cat.var(axis=0), rtol=1e-3)
+
+    def test_median_of_sorted_equals_median(self):
+        v = np.random.default_rng(101).standard_normal(51).astype(np.float32)
+        x = ht.array(v, split=0)
+        sv, _ = ht.sort(x, axis=0)
+        np.testing.assert_allclose(
+            float(ht.median(sv).numpy()), np.median(v), rtol=1e-5
+        )
+
+    def test_standardize_pipeline(self):
+        rng = np.random.default_rng(103)
+        m = rng.standard_normal((40, 6)).astype(np.float32) * 3 + 1
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(m, split=s)
+                z = (x - ht.mean(x, axis=0)) / ht.std(x, axis=0)
+                expected = (m - m.mean(axis=0)) / m.std(axis=0)
+                self.assert_array_equal(z, expected, rtol=1e-3)
+                np.testing.assert_allclose(
+                    ht.mean(z, axis=0).numpy(), np.zeros(6), atol=1e-5
+                )
+
+    def test_argmax_of_rolled(self):
+        v = np.random.default_rng(107).permutation(29).astype(np.float32)
+        x = ht.roll(ht.array(v, split=0), 7)
+        self.assertEqual(
+            int(ht.argmax(x).numpy()), int(np.argmax(np.roll(v, 7)))
+        )
