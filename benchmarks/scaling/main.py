@@ -79,14 +79,54 @@ def main():
         return out
 
     metrics = list(legs[0]["results"])
+
+    # collective-census analysis (round-4: the structural signal). For each
+    # workload: per-mesh-size collective counts must be mesh-size-INVARIANT
+    # (the program's structure does not degrade as devices grow), and
+    # per-device bytes x devices gives the total-wire-vs-devices trend.
+    census_ok = True
+    census_summary = {}
+    for mode in {l["mode"] for l in legs}:
+        mode_legs = sorted(
+            (l for l in legs if l["mode"] == mode and l.get("collective_census")),
+            key=lambda l: l["devices"],
+        )
+        multi = [l for l in mode_legs if l["devices"] > 1]
+        if not multi:
+            continue
+        for wl in multi[0]["collective_census"]:
+            counts = {
+                l["devices"]: {
+                    k: v["count"] for k, v in l["collective_census"][wl].items()
+                }
+                for l in multi
+            }
+            wire = {
+                l["devices"]: sum(
+                    v["bytes_out"] for v in l["collective_census"][wl].values()
+                ) * l["devices"]
+                for l in multi
+            }
+            invariant = len({json.dumps(c, sort_keys=True) for c in counts.values()}) == 1
+            census_ok = census_ok and invariant
+            census_summary[f"{mode}:{wl}"] = {
+                "counts_by_devices": counts,
+                "count_mesh_invariant": invariant,
+                "total_wire_bytes_by_devices": wire,
+            }
+
     doc = {
         "suite": "scaling-2020",
-        "note": "virtual CPU mesh: same host cores for every leg; read the"
-                " trend of the sharded compute+collective structure, not"
-                " hardware speedup",
+        "note": "virtual CPU mesh: same host cores for every leg; wall times"
+                " are secondary — the collective census (counts x bytes per"
+                " compiled program) is the structural multi-chip signal",
         "legs": legs,
         "strong_speedup": {m: eff("strong", m) for m in metrics},
         "weak_efficiency": {m: eff("weak", m) for m in metrics},
+        "census_summary": census_summary,
+        # null (not true) when no multi-device census legs existed: an
+        # unchecked invariant must not read as a verified one
+        "census_counts_mesh_invariant": census_ok if census_summary else None,
     }
     print(json.dumps(doc))
     if args.out:
