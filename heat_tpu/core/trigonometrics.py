@@ -12,7 +12,7 @@ __all__ = [
     "arccos", "acos", "arccosh", "acosh", "arcsin", "asin", "arcsinh", "asinh",
     "arctan", "atan", "arctan2", "atan2", "arctanh", "atanh",
     "cos", "cosh", "deg2rad", "degrees", "rad2deg", "radians",
-    "sin", "sinh", "tan", "tanh",
+    "sin", "sinc", "sinh", "tan", "tanh",
 ]
 
 
@@ -97,6 +97,12 @@ def sinh(x, out=None) -> DNDarray:
 
 def tan(x, out=None) -> DNDarray:
     return _operations._local_op(jnp.tan, x, out=out)
+
+
+def sinc(x, out=None) -> DNDarray:
+    """Normalized sinc sin(pi x)/(pi x) (numpy parity; absent from the
+    reference, added like ``dstack`` to complete the numpy surface)."""
+    return _operations._local_op(jnp.sinc, x, out=out)
 
 
 def tanh(x, out=None) -> DNDarray:

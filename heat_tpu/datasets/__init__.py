@@ -1,6 +1,7 @@
 """Bundled sample datasets (reference: heat/datasets/__init__.py).
 
-Synthetic, license-clean stand-ins with the reference's exact file schema
+The real Fisher-iris and scikit-learn diabetes data (public-domain/BSD,
+redistributed by scikit-learn) in the reference's exact file schema
 (names, shapes, separators, HDF5/NetCDF keys); see ``_generate.py``.
 """
 

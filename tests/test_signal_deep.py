@@ -1,0 +1,204 @@
+"""Signal + rounding/exponential edge matrix (reference models:
+heat/core/tests/test_signal.py — the convolve mode/size/dtype matrix over
+the halo exchange — and the edge-value cases of test_rounding.py /
+test_exponential.py / test_trigonometrics.py).
+
+convolve is the framework's halo showcase: on split inputs the GSPMD
+partitioner materializes the halos the reference hand-exchanges, so the
+matrix runs every (mode x kernel size x split x parity) cell against
+np.convolve, including kernels longer than a device's shard (multi-hop
+halos).
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from .base import TestCase
+
+
+class TestConvolveMatrix(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(501)
+        self.sig = rng.standard_normal(37).astype(np.float32)
+
+    def test_mode_kernel_split_matrix(self):
+        rng = np.random.default_rng(503)
+        for k in (1, 2, 3, 5, 8, 13):
+            kern = rng.standard_normal(k).astype(np.float32)
+            for mode in ("full", "same", "valid"):
+                expected = np.convolve(self.sig, kern, mode=mode)
+                for s in (None, 0):
+                    with self.subTest(k=k, mode=mode, split=s):
+                        r = ht.convolve(
+                            ht.array(self.sig, split=s), ht.array(kern), mode=mode
+                        )
+                        self.assert_array_equal(r, expected, rtol=1e-4, atol=1e-5)
+
+    def test_kernel_longer_than_shard(self):
+        # 37 elements over 8 devices -> shards of 5; a 13-tap kernel needs
+        # halos spanning multiple neighbor shards
+        kern = np.ones(13, np.float32) / 13
+        expected = np.convolve(self.sig, kern, mode="same")
+        r = ht.convolve(ht.array(self.sig, split=0), ht.array(kern), mode="same")
+        self.assert_array_equal(r, expected, rtol=1e-4, atol=1e-5)
+
+    def test_int_inputs_stay_int(self):
+        a = np.arange(12, dtype=np.int32)
+        v = np.asarray([1, 2, 1], np.int32)
+        expected = np.convolve(a, v, mode="full")
+        r = ht.convolve(ht.array(a, split=0), ht.array(v))
+        self.assertEqual(r.dtype, ht.int32)
+        self.assert_array_equal(r, expected)
+
+    def test_kernel_equals_signal_length(self):
+        kern = np.ones(37, np.float32)
+        for mode in ("full", "valid"):
+            expected = np.convolve(self.sig, kern, mode=mode)
+            r = ht.convolve(ht.array(self.sig, split=0), ht.array(kern), mode=mode)
+            self.assert_array_equal(r, expected, rtol=1e-4, atol=1e-4)
+
+    def test_identity_kernel(self):
+        r = ht.convolve(
+            ht.array(self.sig, split=0), ht.array(np.ones(1, np.float32)), mode="same"
+        )
+        self.assert_array_equal(r, self.sig, rtol=1e-6)
+
+    def test_errors(self):
+        with self.assertRaises(ValueError):
+            ht.convolve(
+                ht.array(self.sig.reshape(1, -1), split=0),
+                ht.array(np.ones(3, np.float32)),
+            )
+        with self.assertRaises(ValueError):
+            ht.convolve(ht.array(self.sig), ht.array(np.ones(3, np.float32)), mode="sum")
+
+    def test_convolve_of_chain_output(self):
+        # halo correctness on a non-trivially-laid-out input: roll + pad
+        kern = np.asarray([0.25, 0.5, 0.25], np.float32)
+        x = ht.roll(ht.array(self.sig, split=0), 5)
+        x = ht.pad(x, (2, 2), constant_values=0.0)
+        r = ht.convolve(x, ht.array(kern), mode="valid")
+        expected = np.convolve(
+            np.pad(np.roll(self.sig, 5), 2), kern, mode="valid"
+        )
+        self.assert_array_equal(r, expected, rtol=1e-4, atol=1e-5)
+
+
+class TestRoundingEdges(TestCase):
+    def test_halfway_ties_to_even(self):
+        v = np.asarray([-2.5, -1.5, -0.5, 0.5, 1.5, 2.5], np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                self.assert_array_equal(ht.round(ht.array(v, split=s)), np.round(v))
+
+    def test_floor_ceil_trunc_negative(self):
+        v = np.asarray([-2.7, -2.5, -0.1, 0.0, 0.1, 2.5, 2.7], np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                self.assert_array_equal(ht.floor(ht.array(v, split=s)), np.floor(v))
+                self.assert_array_equal(ht.ceil(ht.array(v, split=s)), np.ceil(v))
+                self.assert_array_equal(ht.trunc(ht.array(v, split=s)), np.trunc(v))
+
+    def test_round_decimals(self):
+        v = np.asarray([1.2345, -9.8765, 0.5555], np.float32)
+        for dec in (0, 1, 2, 3):
+            with self.subTest(dec=dec):
+                np.testing.assert_allclose(
+                    ht.round(ht.array(v, split=0), dec).numpy(),
+                    np.round(v, dec), rtol=1e-4, atol=1e-5,
+                )
+
+    def test_signbit_on_signed_zero_and_inf(self):
+        v = np.asarray([-0.0, 0.0, -np.inf, np.inf, -1.0, np.nan], np.float32)
+        r = ht.signbit(ht.array(v, split=0)).numpy()
+        np.testing.assert_array_equal(r, np.signbit(v))
+
+    def test_clip_scalar_and_array_bounds(self):
+        v = np.linspace(-5, 5, 21).astype(np.float32)
+        lo = np.full(21, -2.0, np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                self.assert_array_equal(
+                    ht.clip(ht.array(v, split=s), -2.0, 3.0), np.clip(v, -2, 3)
+                )
+                self.assert_array_equal(
+                    ht.clip(ht.array(v, split=s), ht.array(lo, split=s), 3.0),
+                    np.clip(v, lo, 3.0),
+                )
+
+
+class TestExponentialEdges(TestCase):
+    def test_log_domain_edges(self):
+        v = np.asarray([0.0, 1.0, np.inf], np.float32)
+        got = ht.log(ht.array(v, split=0)).numpy()
+        np.testing.assert_array_equal(got, np.log(v))  # -inf, 0, inf
+
+    def test_log_negative_is_nan(self):
+        got = ht.log(ht.array(np.asarray([-1.0], np.float32))).numpy()
+        self.assertTrue(np.isnan(got).all())
+
+    def test_expm1_log1p_precision_near_zero(self):
+        v = np.asarray([1e-7, -1e-7, 1e-4], np.float32)
+        np.testing.assert_allclose(
+            ht.expm1(ht.array(v, split=0)).numpy(), np.expm1(v), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            ht.log1p(ht.array(v, split=0)).numpy(), np.log1p(v), rtol=1e-6
+        )
+
+    def test_exp_overflow_to_inf(self):
+        got = ht.exp(ht.array(np.asarray([100.0], np.float32))).numpy()
+        self.assertTrue(np.isinf(got).all())
+
+    def test_sqrt_negative_nan(self):
+        v = np.asarray([-4.0, 0.0, 4.0], np.float32)
+        got = ht.sqrt(ht.array(v, split=0)).numpy()
+        self.assertTrue(np.isnan(got[0]))
+        np.testing.assert_array_equal(got[1:], [0.0, 2.0])
+
+    def test_power_edge_cases(self):
+        # 0**0 == 1, (-2)**3 == -8, 2**-1 float
+        base = np.asarray([0.0, -2.0, 2.0], np.float32)
+        exp = np.asarray([0.0, 3.0, -1.0], np.float32)
+        np.testing.assert_allclose(
+            ht.pow(ht.array(base, split=0), ht.array(exp, split=0)).numpy(),
+            np.power(base, exp), rtol=1e-6,
+        )
+
+
+class TestTrigEdges(TestCase):
+    def test_arcsin_domain_edge(self):
+        v = np.asarray([-1.0, 0.0, 1.0], np.float32)
+        np.testing.assert_allclose(
+            ht.arcsin(ht.array(v, split=0)).numpy(), np.arcsin(v), rtol=1e-6
+        )
+        out = ht.arcsin(ht.array(np.asarray([1.5], np.float32))).numpy()
+        self.assertTrue(np.isnan(out).all())
+
+    def test_arctan2_quadrants(self):
+        y = np.asarray([1.0, 1.0, -1.0, -1.0, 0.0], np.float32)
+        x = np.asarray([1.0, -1.0, 1.0, -1.0, -2.0], np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                np.testing.assert_allclose(
+                    ht.arctan2(ht.array(y, split=s), ht.array(x, split=s)).numpy(),
+                    np.arctan2(y, x), rtol=1e-6,
+                )
+
+    def test_sinc_at_zero(self):
+        v = np.asarray([-1.0, 0.0, 0.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            ht.sinc(ht.array(v, split=0)).numpy(), np.sinc(v), rtol=1e-5, atol=1e-6
+        )
+
+    def test_degrees_radians_roundtrip(self):
+        v = np.linspace(-720, 720, 29).astype(np.float32)
+        r = ht.radians(ht.array(v, split=0))
+        back = ht.degrees(r).numpy()
+        np.testing.assert_allclose(back, v, rtol=1e-4)
+
+    def test_hyperbolic_identity(self):
+        v = np.linspace(-3, 3, 13).astype(np.float32)
+        c = ht.cosh(ht.array(v, split=0)).numpy()
+        s = ht.sinh(ht.array(v, split=0)).numpy()
+        np.testing.assert_allclose(c**2 - s**2, np.ones(13), rtol=1e-3)
