@@ -263,4 +263,8 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         from ..core import sanitation
 
         sanitation.sanitize_in(x)
+        if self._cluster_centers is None:
+            raise RuntimeError(
+                f"{type(self).__name__} is not fitted yet; call fit() before predict()"
+            )
         return self._assign_to_cluster(x)
