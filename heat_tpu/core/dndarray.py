@@ -600,14 +600,37 @@ class DNDarray:
         if isinstance(key, _D):
             key = key.larray
         if isinstance(key, (list,)):
-            key = jnp.asarray(key)
+            key = np.asarray(key)  # np, not jnp: keeps the bounds check live
         if not isinstance(key, tuple):
             key = (key,)
         else:
-            key = tuple(k.larray if isinstance(k, _D) else k for k in key)
+            key = tuple(
+                k.larray if isinstance(k, _D)
+                else np.asarray(k) if isinstance(k, list)
+                else k
+                for k in key
+            )
+        # jnp's indexer rejects np.bool_ scalars (only python bool / arrays)
+        key = tuple(bool(k) if isinstance(k, np.bool_) else k for k in key)
 
-        # expand Ellipsis (identity checks: arrays break == comparisons)
-        n_specified = sum(1 for k in key if k is not None and k is not Ellipsis)
+        # expand Ellipsis (identity checks: arrays break == comparisons).
+        # Scalar bools are 0-d masks (numpy: x[True] == x[None]) — they add
+        # an output dim but consume none, so they don't count as specified.
+        def _is_scalar_bool(k):
+            return isinstance(k, (bool, np.bool_))
+
+        def _dims_consumed(k):
+            if k is None or k is Ellipsis or _is_scalar_bool(k):
+                return 0
+            if (
+                isinstance(k, (np.ndarray, jnp.ndarray, jax.Array))
+                and np.ndim(k) > 0
+                and k.dtype == np.bool_
+            ):
+                return np.ndim(k)  # an n-D mask consumes n dims
+            return 1
+
+        n_specified = sum(_dims_consumed(k) for k in key)
         if any(k is Ellipsis for k in key):
             e = next(i for i, k in enumerate(key) if k is Ellipsis)
             fill = (slice(None),) * (self.ndim - n_specified)
@@ -624,8 +647,8 @@ class DNDarray:
         # them would force a device sync per getitem.
         dim = 0
         for k in key:
-            if k is None:
-                continue
+            if k is None or _is_scalar_bool(k):
+                continue  # newaxis / 0-d mask: no dim consumed, no bounds
             is_bool_arr = (
                 isinstance(k, (np.ndarray, jnp.ndarray, jax.Array))
                 and np.ndim(k) > 0
@@ -641,7 +664,7 @@ class DNDarray:
                         f"index {int(k)} is out of bounds for dimension {dim} "
                         f"with size {n}"
                     )
-            elif isinstance(k, (list, np.ndarray)) and np.ndim(k) > 0:
+            elif isinstance(k, np.ndarray) and np.ndim(k) > 0:
                 ka = np.asarray(k)
                 n = self.__gshape[dim] if dim < self.ndim else 0
                 if ka.size and (int(ka.min()) < -n or int(ka.max()) >= n):
@@ -675,8 +698,8 @@ class DNDarray:
         in_dim = 0
         out_dim = 0
         for k in key:
-            if k is None:
-                out_dim += 1
+            if k is None or _is_scalar_bool(k):
+                out_dim += 1  # newaxis / 0-d mask adds a dim, consumes none
                 continue
             if isinstance(k, slice):
                 if in_dim == self.__split:
@@ -706,8 +729,8 @@ class DNDarray:
         out = []
         in_dim = 0
         for k in key:
-            if k is None:
-                out.append(k)
+            if k is None or isinstance(k, (bool, np.bool_)):
+                out.append(k)  # newaxis / 0-d mask: no input dim consumed
                 continue
             if (
                 isinstance(k, (jnp.ndarray, jax.Array, np.ndarray))
@@ -750,7 +773,7 @@ class DNDarray:
         bcast_nd = 0
         only_split_1d = True  # legacy fast case: one 1-D key on the split axis
         for pos, k in enumerate(key):
-            if k is None:
+            if k is None or isinstance(k, (bool, np.bool_)):
                 continue
             if is_arr(k):
                 if in_dim == self.__split:
@@ -801,7 +824,7 @@ class DNDarray:
         in_cursor = 0
         block_done = not contiguous
         for pos, k in enumerate(key):
-            if k is None:
+            if k is None or isinstance(k, (bool, np.bool_)):
                 out_pos += 1
                 continue
             if isinstance(k, slice) and not is_arr(k):

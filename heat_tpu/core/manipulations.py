@@ -75,6 +75,14 @@ def _wrap(arr, like: DNDarray, split) -> DNDarray:
     return _ensure_split(out, split)
 
 
+def _require_dndarray(arrays: Sequence, fname: str) -> DNDarray:
+    """First DNDarray in ``arrays``; TypeError otherwise (stack-family guard)."""
+    ref = next((a for a in arrays if isinstance(a, DNDarray)), None)
+    if ref is None:
+        raise TypeError(f"{fname} expected at least one DNDarray input")
+    return ref
+
+
 def balance(array: DNDarray, copy: bool = False) -> DNDarray:
     """Out-of-place balance (reference: manipulations.py:63). Always already
     balanced under GSPMD."""
@@ -102,8 +110,8 @@ def broadcast_to(x: DNDarray, shape) -> DNDarray:
 
 def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Stack 1-D/2-D arrays as columns (reference: manipulations.py)."""
+    ref = _require_dndarray(arrays, "column_stack")
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
-    ref = next(a for a in arrays if isinstance(a, DNDarray))
     result = jnp.column_stack(prepared)
     split = ref.split if ref.split == 0 else None
     return _wrap(result, ref, split)
@@ -203,7 +211,7 @@ def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
 
 def hstack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Horizontal stack."""
-    ref = next(a for a in arrays if isinstance(a, DNDarray))
+    ref = _require_dndarray(arrays, "hstack")
     axis = 0 if ref.ndim == 1 else 1
     return concatenate(arrays, axis=axis)
 
@@ -212,7 +220,7 @@ def dstack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Depth-wise stack along the third axis (numpy parity; the reference
     ships vstack/hstack/row_stack only — dstack completes the family the
     same way dsplit already does)."""
-    ref = next(a for a in arrays if isinstance(a, DNDarray))
+    ref = _require_dndarray(arrays, "dstack")
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
     result = jnp.dstack(prepared)
     if ref.ndim == 1:
@@ -465,7 +473,7 @@ def squeeze(x: DNDarray, axis=None) -> DNDarray:
 
 def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
     """Join along a new axis (reference: manipulations.py stack)."""
-    ref = next(a for a in arrays if isinstance(a, DNDarray))
+    ref = _require_dndarray(arrays, "stack")
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
     result = jnp.stack(prepared, axis=axis)
     split = ref.split
@@ -665,7 +673,7 @@ def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
 
 
 def vstack(arrays: Sequence[DNDarray]) -> DNDarray:
-    ref = next(a for a in arrays if isinstance(a, DNDarray))
+    ref = _require_dndarray(arrays, "vstack")
     prepared = []
     for a in arrays:
         v = a.larray if isinstance(a, DNDarray) else jnp.asarray(a)

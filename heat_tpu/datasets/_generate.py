@@ -16,6 +16,13 @@ bytes): same file names, shapes, separators, and dataset/variable keys.
 - ``diabetes.h5``: 'x' = (442, 11) intercept column + 10 standardized
   features, 'y' = (442,) response — the reference's exact keys/shapes.
 
+Exactness caveat (round-4 advisor): sklearn's ``load_iris`` differs from
+the reference's shipped ``iris.csv`` in 2 rows (max delta 0.5 — the known
+UCI-vs-Fisher discrepancy, rows 34 and 37), and ``diabetes.h5`` 'x'
+differs by up to ~1.2e-5 (a normalization variant).  The fixtures here are
+value-equivalent sample data, not byte-identical copies of the reference
+files; tests treat them as such.
+
 Run ``python -m heat_tpu.datasets._generate`` to rewrite the files.
 """
 

@@ -579,3 +579,93 @@ class TestGetSetChains(TestCase):
                 np.fill_diagonal(expected, 2.0)
                 expected = expected + np.eye(9, dtype=np.float32)
                 self.assert_array_equal(y, expected)
+
+
+class TestScalarBoolKeys(TestCase):
+    """Round-4 advisor: scalar bools are 0-d masks, not integer indices."""
+
+    def test_true_on_size1_dim(self):
+        host = np.ones((1, 3), np.float32)
+        x = ht.array(host)
+        self.assert_array_equal(x[True], host[True])
+
+    def test_false_on_size1_dim(self):
+        host = np.ones((1, 3), np.float32)
+        x = ht.array(host)
+        self.assertEqual(x[False].shape, host[False].shape)
+
+    def test_scalar_bool_split_array(self):
+        host = np.arange(24, dtype=np.float32).reshape(8, 3)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                got = x[True]
+                self.assert_array_equal(got, host[True])
+                self.assertEqual(x[False].shape, host[False].shape)
+
+    def test_scalar_bool_in_tuple(self):
+        host = np.arange(12, dtype=np.float32).reshape(4, 3)
+        x = ht.array(host, split=0)
+        self.assert_array_equal(x[True, 1:], host[True, 1:])
+
+    def test_np_bool_scalar(self):
+        host = np.ones((1, 3), np.float32)
+        x = ht.array(host)
+        self.assert_array_equal(x[np.bool_(True)], host[np.bool_(True)])
+
+
+class TestBoolListKeys(TestCase):
+    """Round-4 advisor: bool lists in tuple keys are masks, not int arrays."""
+
+    def test_bool_list_on_size1_dim(self):
+        host = np.ones((1, 3), np.float32)
+        x = ht.array(host)
+        self.assert_array_equal(x[[True], :], host[[True], :])
+
+    def test_bool_list_mask_rows(self):
+        host = np.arange(20, dtype=np.float32).reshape(5, 4)
+        sel = [True, False, True, False, True]
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                self.assert_array_equal(x[sel, :], host[sel, :])
+
+    def test_int_list_in_tuple_is_advanced(self):
+        host = np.arange(20, dtype=np.float32).reshape(5, 4)
+        x = ht.array(host, split=0)
+        self.assert_array_equal(x[:, [0, 2]], host[:, [0, 2]])
+        with self.assertRaises(IndexError):
+            x[[0, 9], :]
+
+
+class TestStackFamilyErrors(TestCase):
+    """Round-4 advisor: explicit TypeError when no DNDarray input."""
+
+    def test_no_dndarray_raises_typeerror(self):
+        for fn in (ht.vstack, ht.hstack, ht.dstack, ht.column_stack, ht.stack):
+            with self.subTest(fn=fn.__name__):
+                with self.assertRaises(TypeError):
+                    fn([np.ones(3), np.ones(3)])
+
+
+class TestReviewFoundEdges(TestCase):
+    """Round-5 review findings on the scalar-bool fix itself."""
+
+    def test_scalar_bool_then_mask(self):
+        host = np.arange(4, dtype=np.float32)
+        x = ht.array(host)
+        sel = np.array([True, False, True, False])
+        self.assert_array_equal(x[True, sel], host[True, sel])
+
+    def test_ellipsis_with_2d_mask(self):
+        host = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        mask = host[0] > 5
+        for s in _splits(3):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                self.assert_array_equal(x[..., mask], host[..., mask])
+
+    def test_bare_list_out_of_bounds(self):
+        x = ht.array(np.arange(20, dtype=np.float32).reshape(5, 4))
+        with self.assertRaises(IndexError):
+            x[[0, 9]]
