@@ -82,12 +82,28 @@ def census_leg(data, Y, xs, y_t) -> dict:
 
     from heat_tpu.ops.cdist import cdist as ops_cdist
 
+    # replicated-Y cdist (the 2020 workload) compiles collective-free BY
+    # DESIGN — every shard holds Y, so the program is pure local compute;
+    # an empty census here is the finding, not a blind spot
     censuses["cdist_call"] = hlo_census(
         jax.jit(lambda a, b: ops_cdist(a, b))
         .lower(data.parray, Y.larray)
         .compile()
         .as_text()
     )
+
+    # the split-x-split RING cdist is where cdist's wire structure lives
+    # (reference: the Isend/Irecv ring, spatial/distance.py:209; here a
+    # ppermute chain inside one fori_loop — counted once, structure not
+    # trip count)
+    from heat_tpu.spatial.distance import _build_ring_cdist
+
+    n_dev = data.comm.size
+    if n_dev > 1:
+        ring = _build_ring_cdist(data.comm.mesh, data.comm.split_axis, n_dev, True)
+        censuses["cdist_ring"] = hlo_census(
+            jax.jit(ring).lower(data.parray, data.parray).compile().as_text()
+        )
 
     theta = jnp.zeros((xs.shape[1],), jnp.float32)
     censuses["lasso_cd_sweep"] = hlo_census(

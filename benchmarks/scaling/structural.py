@@ -1,0 +1,209 @@
+# One leg of the STRUCTURAL collective census (round-5; VERDICT r4 next #1).
+#
+# The 2020-suite census (run_one.py) proved "estimators all-reduce small
+# buffers" — true but low-signal.  The programs where wire structure is the
+# actual multi-chip risk are the ones with data-volume collectives:
+#
+#   columnsort    2 all_to_all steps, O(n) bytes      (parallel/sort.py)
+#   odd-even net  ppermute rounds grow with S          (parallel/sort.py)
+#   TSQR          1 all-gather of S*k^2 R-panel bytes  (core/linalg/qr.py)
+#   matmul        GSPMD-chosen collectives per split   (core/linalg/basics.py)
+#   mask-select   1 psum_scatter of output volume      (parallel/select.py)
+#   MoE dispatch  2 all_to_all of capacity slabs       (parallel/expert.py)
+#   resplit 0->1  1 all_to_all of the local slab       (XLA resharding)
+#   ring cdist    ppermute chain inside fori_loop      (spatial/distance.py)
+#
+# This leg script lowers each program's ACTUAL compiled HLO on a forced
+# D-device CPU mesh at TWO problem sizes and emits the per-kind
+# {count, bytes_out} census (bytes_out = per-participant output buffer —
+# the wire-volume proxy tests/test_dist_sort.py asserts on).  The runner
+# (structural_main.py) sweeps D in {2,4,8} and asserts each workload's
+# scaling law: instruction counts mesh-invariant, bytes linear in n (or
+# explicitly invariant), per-device bytes falling ~1/D (or explicitly
+# growing ~D for TSQR's gather — that growth IS the TSQR tradeoff).
+#
+# Everything here is compile-only: no workload is executed, so a full leg
+# is seconds, and the census is exact (static HLO, not sampled traffic).
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+from run_one import hlo_census  # noqa: E402  (shared HLO parser)
+
+
+def census_of(jitted, *args) -> dict:
+    return hlo_census(jitted.lower(*args).compile().as_text())
+
+
+def jaxpr_prims(fn, *args) -> dict:
+    """Collective-primitive counts in the jaxpr — the ALGORITHM census
+    (mesh-size-independent by construction when the program is; XLA may
+    re-lower one primitive differently per mesh size)."""
+    import jax
+
+    text = str(jax.make_jaxpr(fn)(*args))
+    return {
+        p: text.count(p)
+        for p in ("all_to_all", "ppermute", "all_gather", "psum_scatter", "psum")
+        if text.count(p)
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, required=True)
+    ap.add_argument("--base-n", type=int, default=20_000)
+    args = ap.parse_args()
+    D = args.devices
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == D, (
+        f"mesh has {len(jax.devices())} devices, wanted {D} — set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count"
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import heat_tpu as ht
+    from heat_tpu.parallel.mesh import sanitize_comm
+
+    comm = sanitize_comm(None)
+    mesh, ax = comm.mesh, comm.split_axis
+
+    def sharded(shape, split, dtype=jnp.float32):
+        """Canonical physical layout: split dim padded to a multiple of D."""
+        phys = list(shape)
+        phys[split] = -(-shape[split] // D) * D
+        x = jnp.zeros(tuple(phys), dtype)
+        return jax.device_put(x, comm.sharding(split, len(shape)))
+
+    legs = {}
+
+    for scale, n in (("n1", args.base_n), ("n2", 2 * args.base_n)):
+        leg = {}
+
+        # -- sort: columnsort (forced) and the odd-even network ----------
+        from heat_tpu.parallel.sort import (
+            _build_columnsort,
+            _build_sorter,
+        )
+
+        per = -(-n // D)
+        keys = sharded((per * D,), 0)
+        cs = _build_columnsort(mesh, ax, 0, 1, n, per)
+        leg["columnsort"] = {
+            "hlo": census_of(jax.jit(cs), keys),
+            "jaxpr": jaxpr_prims(cs, keys),
+        }
+        net = _build_sorter(mesh, ax, 0, 1, n, per)
+        leg["sort_network"] = {
+            "hlo": census_of(jax.jit(net), keys),
+            "jaxpr": jaxpr_prims(net, keys),
+        }
+
+        # -- TSQR: one all-gather of the S k-by-k R panels ----------------
+        from heat_tpu.core.linalg.qr import _build_tsqr
+
+        k = 64
+        rows = max(n // 16, k * D)
+        block = sharded((-(-rows // D) * D, k), 0)
+        tq = _build_tsqr(mesh, ax, True)
+        leg["tsqr"] = {
+            "hlo": census_of(jax.jit(tq), block),
+            "jaxpr": jaxpr_prims(tq, block),
+        }
+
+        # -- matmul: the GSPMD einsum over every split combo --------------
+        # (the reference's ~700-line case table, linalg/basics.py:424; here
+        # the census shows which collectives GSPMD chose per combo)
+        m = 512
+        for sa, sb in ((0, 0), (0, 1), (1, 0), (1, 1), (0, None), (None, 1)):
+            a = sharded((m, m), sa) if sa is not None else jnp.zeros((m, m))
+            b = sharded((m, m), sb) if sb is not None else jnp.zeros((m, m))
+            out_split = 0 if sa == 0 else (1 if sb == 1 else None)
+            out_spec = comm.spec(out_split, 2) if out_split is not None else P()
+
+            def mm(x, y, _spec=out_spec):
+                return jax.lax.with_sharding_constraint(
+                    jnp.matmul(x, y), NamedSharding(mesh, _spec)
+                )
+
+            leg[f"matmul_s{sa}{sb}"] = {"hlo": census_of(jax.jit(mm), a, b)}
+
+        # -- distributed mask-select: ONE psum_scatter of output volume ---
+        from heat_tpu.parallel.select import _build_mask_select
+
+        n_sel = n // 2
+        per_out = -(-n_sel // D)
+        vals = sharded((per * D,), 0)
+        mask = sharded((per * D,), 0, jnp.bool_)
+        ms = _build_mask_select(mesh, ax, 0, 1, n, per_out, False)
+        leg["mask_select"] = {
+            "hlo": census_of(jax.jit(ms), vals, mask),
+            "jaxpr": jaxpr_prims(ms, vals, mask),
+        }
+
+        # -- MoE dispatch: two all_to_alls of capacity slabs ---------------
+        from functools import partial
+
+        from heat_tpu.parallel.collectives import shard_map_unchecked
+        from heat_tpu.parallel.expert import _moe_shard, expert_capacity
+
+        d_model, d_ff, E, topk = 64, 128, 8, 2
+        tokens = max(n // 8 // D, 8) * D
+        cap = expert_capacity(tokens // D, E, topk, 2.0)
+        moe = shard_map_unchecked(
+            partial(_moe_shard, k=topk, capacity=cap, activation=jax.nn.gelu, axis=ax),
+            mesh,
+            in_specs=(P(ax, None), P(), P(ax, None, None), P(ax, None, None)),
+            out_specs=(P(ax, None), P()),
+        )
+        xt = sharded((tokens, d_model), 0)
+        gw = jnp.zeros((d_model, E))
+        wi = sharded((E, d_model, d_ff), 0)
+        wo = sharded((E, d_ff, d_model), 0)
+        leg["moe_dispatch"] = {
+            "hlo": census_of(jax.jit(moe), xt, gw, wi, wo),
+            "jaxpr": jaxpr_prims(moe, xt, gw, wi, wo),
+        }
+
+        # -- resplit 0 -> 1: XLA's resharding all-to-all -------------------
+        rrows = -(-max(n // 32, D) // D) * D
+        rc = 512  # fixed (divisible by any D here): per-device slab must
+        # shrink ~1/D in the strong law, so no dimension may scale with D
+        xr = sharded((rrows, rc), 0)
+
+        def resplit01(x):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, comm.spec(1, 2))
+            )
+
+        leg["resplit_0to1"] = {"hlo": census_of(jax.jit(resplit01), xr)}
+
+        # -- ring cdist: stationary x blocks, y blocks ride a ppermute ring
+        from heat_tpu.spatial.distance import _build_ring_cdist
+
+        crows = -(-max(n // 32, D) // D) * D
+        xs_ = sharded((crows, 32), 0)
+        ys_ = sharded((crows, 32), 0)
+        ring = _build_ring_cdist(mesh, ax, D, True)
+        leg["ring_cdist"] = {
+            "hlo": census_of(jax.jit(ring), xs_, ys_),
+            "jaxpr": jaxpr_prims(ring, xs_, ys_),
+        }
+
+        legs[scale] = {"n": n, "workloads": leg}
+
+    print(json.dumps({"devices": D, "scales": legs}))
+
+
+if __name__ == "__main__":
+    main()

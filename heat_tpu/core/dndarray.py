@@ -87,6 +87,20 @@ def _split_axis_shards(phys: jax.Array, split: int):
     return [by_start[k] for k in sorted(by_start)]
 
 
+def _is_scalar_bool_key(k) -> bool:
+    """A 0-d mask key: python bool, np.bool_, or a 0-d boolean array.
+    NumPy treats all three identically (x[True] == x[None] shape-wise;
+    with other advanced keys present they join the broadcast block while
+    consuming and producing no dimension)."""
+    if isinstance(k, (bool, np.bool_)):
+        return True
+    return (
+        isinstance(k, (np.ndarray, jnp.ndarray, jax.Array))
+        and np.ndim(k) == 0
+        and k.dtype == np.bool_
+    )
+
+
 def _physical_dim(n: int, nshards: int) -> int:
     """Physical size of a split dimension: the smallest multiple of the shard
     count ≥ n. XLA's GSPMD only represents even tilings at array boundaries,
@@ -585,6 +599,7 @@ class DNDarray:
         )
 
     # --------------------------------------------------------------- indexing
+    # (module-level helper bound below the class: _is_scalar_bool_key)
     def __process_key(self, key):
         """Normalize an indexing key; return (jnp_key, new_split).
 
@@ -614,10 +629,10 @@ class DNDarray:
         key = tuple(bool(k) if isinstance(k, np.bool_) else k for k in key)
 
         # expand Ellipsis (identity checks: arrays break == comparisons).
-        # Scalar bools are 0-d masks (numpy: x[True] == x[None]) — they add
-        # an output dim but consume none, so they don't count as specified.
-        def _is_scalar_bool(k):
-            return isinstance(k, (bool, np.bool_))
+        # Scalar bools — python bools and 0-d bool arrays alike — are 0-d
+        # masks (numpy: x[True] == x[None]): they add an output dim but
+        # consume none, so they don't count as specified.
+        _is_scalar_bool = _is_scalar_bool_key
 
         def _dims_consumed(k):
             if k is None or k is Ellipsis or _is_scalar_bool(k):
@@ -729,7 +744,7 @@ class DNDarray:
         out = []
         in_dim = 0
         for k in key:
-            if k is None or isinstance(k, (bool, np.bool_)):
+            if k is None or _is_scalar_bool_key(k):
                 out.append(k)  # newaxis / 0-d mask: no input dim consumed
                 continue
             if (
@@ -773,7 +788,13 @@ class DNDarray:
         bcast_nd = 0
         only_split_1d = True  # legacy fast case: one 1-D key on the split axis
         for pos, k in enumerate(key):
-            if k is None or isinstance(k, (bool, np.bool_)):
+            if k is None:
+                continue
+            if _is_scalar_bool_key(k):
+                # 0-d masks JOIN the advanced block (their position decides
+                # contiguity/front placement) but consume and produce no dim
+                only_split_1d = False
+                block_positions.append(pos)
                 continue
             if is_arr(k):
                 if in_dim == self.__split:
@@ -824,8 +845,14 @@ class DNDarray:
         in_cursor = 0
         block_done = not contiguous
         for pos, k in enumerate(key):
-            if k is None or isinstance(k, (bool, np.bool_)):
+            if k is None:
                 out_pos += 1
+                continue
+            if _is_scalar_bool_key(k):
+                # block member with no dims of its own
+                if not block_done and pos == lo:
+                    out_pos += bcast_nd
+                    block_done = True
                 continue
             if isinstance(k, slice) and not is_arr(k):
                 if in_cursor == self.__split:

@@ -124,9 +124,7 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     arrays = list(arrays)
     if len(arrays) < 1:
         raise ValueError("need at least one array to concatenate")
-    ref = next((a for a in arrays if isinstance(a, DNDarray)), None)
-    if ref is None:
-        raise TypeError("expected at least one DNDarray input")
+    ref = _require_dndarray(arrays, "concatenate")
     axis = stride_tricks.sanitize_axis(ref.shape, axis)
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
     # validate up front so shape mismatches surface as ValueError (the

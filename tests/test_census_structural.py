@@ -1,0 +1,155 @@
+"""Structural collective-census laws, pinned in the suite (round 5).
+
+The scaling artifact (benchmarks/scaling/structural_main.py) sweeps mesh
+sizes in subprocesses; this test pins the same claims at the suite's own
+8-device mesh so a regression in any kernel's wire structure fails CI, not
+just the benchmark run.  Census = compiled-HLO instruction counts + the
+per-participant output-buffer bytes (the convention of
+tests/test_dist_sort.py::test_wire_traffic_independent_of_mesh_size).
+"""
+
+import sys
+import os
+import unittest
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from .base import TestCase
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmarks", "scaling"))
+from run_one import hlo_census  # noqa: E402
+
+
+def census(jitted, *args):
+    return hlo_census(jitted.lower(*args).compile().as_text())
+
+
+@unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+class TestStructuralCensus(TestCase):
+    """Exact collective structure of the data-volume kernels."""
+
+    def _sharded(self, shape, split, dtype=jnp.float32):
+        comm = self.comm
+        phys = list(shape)
+        phys[split] = -(-shape[split] // comm.size) * comm.size
+        return jax.device_put(
+            jnp.zeros(tuple(phys), dtype), comm.sharding(split, len(shape))
+        )
+
+    def test_columnsort_two_a2a_steps(self):
+        from heat_tpu.parallel.sort import _build_columnsort
+
+        n = 8192
+        keys = self._sharded((n,), 0)
+        fn = _build_columnsort(self.comm.mesh, self.comm.split_axis, 0, 1,
+                               n, n // self.comm.size)
+        c = census(jax.jit(fn), keys)
+        # 2 deal steps x 3 carried arrays; never an all-gather
+        self.assertEqual(c["all-to-all"]["count"], 6)
+        self.assertNotIn("all-gather", c)
+        self.assertLessEqual(c.get("collective-permute", {}).get("count", 0), 9)
+        # O(n) wire: doubling n doubles the a2a bytes
+        keys2 = self._sharded((2 * n,), 0)
+        fn2 = _build_columnsort(self.comm.mesh, self.comm.split_axis, 0, 1,
+                                2 * n, 2 * n // self.comm.size)
+        c2 = census(jax.jit(fn2), keys2)
+        self.assertEqual(c2["all-to-all"]["bytes_out"],
+                         2 * c["all-to-all"]["bytes_out"])
+
+    def test_tsqr_one_all_gather_of_r_panels(self):
+        from heat_tpu.core.linalg.qr import _build_tsqr
+
+        k, rows = 32, 1024
+        block = self._sharded((rows, k), 0)
+        fn = jax.jit(_build_tsqr(self.comm.mesh, self.comm.split_axis, True))
+        c = census(fn, block)
+        self.assertEqual(c["all-gather"]["count"], 1)
+        # the gather carries S k-by-k panels per device — row-count-free
+        self.assertEqual(c["all-gather"]["bytes_out"],
+                         self.comm.size * k * k * 4)
+        self.assertNotIn("all-to-all", c)
+
+    def test_mask_select_one_reduce_scatter(self):
+        from heat_tpu.parallel.select import _build_mask_select
+
+        n, n_sel = 8000, 4000
+        per_out = -(-n_sel // self.comm.size)
+        vals = self._sharded((n,), 0)
+        mask = self._sharded((n,), 0, jnp.bool_)
+        fn = jax.jit(_build_mask_select(
+            self.comm.mesh, self.comm.split_axis, 0, 1, n, per_out, False))
+        c = census(fn, vals, mask)
+        self.assertEqual(c["reduce-scatter"]["count"], 1)
+        # output volume only: per-device bytes = ceil(n_sel/S) * 4
+        self.assertEqual(c["reduce-scatter"]["bytes_out"], per_out * 4)
+        # count exchange is one int32 per shard
+        self.assertEqual(c["all-gather"]["bytes_out"], self.comm.size * 4)
+
+    def test_moe_two_all_to_alls(self):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from heat_tpu.parallel.collectives import shard_map_unchecked
+        from heat_tpu.parallel.expert import _moe_shard, expert_capacity
+
+        S = self.comm.size
+        d, h, E, k, tokens = 32, 64, 8, 2, 64 * S
+        cap = expert_capacity(tokens // S, E, k, 2.0)
+        ax = self.comm.split_axis
+        fn = shard_map_unchecked(
+            partial(_moe_shard, k=k, capacity=cap,
+                    activation=jax.nn.gelu, axis=ax),
+            self.comm.mesh,
+            in_specs=(P(ax, None), P(), P(ax, None, None), P(ax, None, None)),
+            out_specs=(P(ax, None), P()),
+        )
+        c = census(
+            jax.jit(fn),
+            self._sharded((tokens, d), 0), jnp.zeros((d, E)),
+            self._sharded((E, d, h), 0), self._sharded((E, h, d), 0),
+        )
+        self.assertEqual(c["all-to-all"]["count"], 2)
+
+    def test_resplit_one_all_to_all(self):
+        from jax.sharding import NamedSharding
+
+        x = self._sharded((512, 512), 0)
+
+        def resplit01(v):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(self.comm.mesh, self.comm.spec(1, 2)))
+
+        c = census(jax.jit(resplit01), x)
+        self.assertEqual(c["all-to-all"]["count"], 1)
+        # per-device wire = the local slab
+        self.assertEqual(c["all-to-all"]["bytes_out"],
+                         512 * 512 * 4 // self.comm.size)
+
+    def test_matmul_gspmd_case_table(self):
+        """The reference's 700-line split dispatch (linalg/basics.py:424)
+        as GSPMD chooses it: split-0 rows gather the partner, inner splits
+        all-reduce, replicated partners compile collective-free."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        m = 256
+        mesh = self.comm.mesh
+
+        def mm(spec_out):
+            def f(a, b):
+                return jax.lax.with_sharding_constraint(
+                    jnp.matmul(a, b), NamedSharding(mesh, spec_out))
+            return f
+
+        a0 = self._sharded((m, m), 0)
+        b1 = self._sharded((m, m), 1)
+        bN = jnp.zeros((m, m))
+        c = census(jax.jit(mm(self.comm.spec(0, 2))), a0, bN)
+        self.assertEqual(c, {})  # replicated partner: fully local
+        c = census(jax.jit(mm(self.comm.spec(None, 2))),
+                   self._sharded((m, m), 1), self._sharded((m, m), 0))
+        self.assertEqual(c["all-reduce"]["count"], 1)  # inner contraction

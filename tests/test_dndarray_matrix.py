@@ -669,3 +669,38 @@ class TestReviewFoundEdges(TestCase):
         x = ht.array(np.arange(20, dtype=np.float32).reshape(5, 4))
         with self.assertRaises(IndexError):
             x[[0, 9]]
+
+
+class TestScalarBoolAdvancedBlock(TestCase):
+    """Round-5 second review pass: scalar bools (and 0-d bool arrays) join
+    the advanced block — contiguity/placement — while consuming and
+    producing no dimension."""
+
+    def test_bool_joins_block(self):
+        host = np.arange(20, dtype=np.float32).reshape(5, 4)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                self.assert_array_equal(x[[0, 2], True], host[[0, 2], True])
+
+    def test_bool_forces_front_placement(self):
+        host = np.arange(30, dtype=np.float32).reshape(2, 5, 3)
+        for s in _splits(3):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                self.assert_array_equal(
+                    x[True, :, [0, 2]], host[True, :, [0, 2]])
+                self.assert_array_equal(
+                    x[:, [0, 2], True], host[:, [0, 2], True])
+                self.assert_array_equal(
+                    x[0, True, [0, 2]], host[0, True, [0, 2]])
+
+    def test_zero_d_bool_array_is_mask(self):
+        host = np.arange(20, dtype=np.float32).reshape(5, 4)
+        for s in _splits(2):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                self.assert_array_equal(
+                    x[np.array(True)], host[np.array(True)])
+                self.assert_array_equal(
+                    x[:, np.array(True)], host[:, np.array(True)])
