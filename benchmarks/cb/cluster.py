@@ -53,6 +53,12 @@ def _lloyd_slope():
         "kmeans_lloyd_iter", sl.per_unit_s, per="lloyd-iteration",
         n=config.LLOYD_N, f=config.LLOYD_F, k=config.LLOYD_K,
         **sl.fields(),
+        # mandatory traffic: one pass over X per iteration (centers/labels
+        # are noise at f=64, k=8) — Lloyd at this shape is HBM-bound, so
+        # the roofline fraction is the honest score, not MFU
+        **config.hbm_fields(
+            config.LLOYD_N * config.LLOYD_F * 4.0, sl.per_unit_s
+        ),
     )
 
 
@@ -78,6 +84,8 @@ def _northstar_slope():
         "kmeans_lloyd_iter_bf16_northstar", sl.per_unit_s,
         per="lloyd-iteration", n=n, f=f, k=k, dtype="bfloat16",
         packed=True, **sl.fields(),
+        # one bf16 pass over the packed payload per iteration
+        **config.hbm_fields(n * f * 2.0, sl.per_unit_s),
     )
 
 

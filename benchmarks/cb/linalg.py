@@ -85,6 +85,10 @@ def run():
         record(
             f"matmul_split_{sp}", sl.per_unit_s, per="matmul",
             **sl.fields(),
+            **config.mfu_fields(
+                config.matmul_flops(n), sl.per_unit_s,
+                config.PEAK_BF16_TFLOPS, "v5e bf16 (default matmul precision)",
+            ),
         )
         del a, b
 
@@ -101,6 +105,10 @@ def run():
                 config.qr_flops(qn, qn), sl.per_unit_s,
                 config.PEAK_F32_TFLOPS, "v5e f32 = bf16/4",
             ),
+            note="reference-CI shape (square n=2048): the panel recursion "
+                 "is bandwidth/latency-bound at this size — sub-bar MFU is "
+                 "the shape's ceiling, not implementation; the compute-"
+                 "bound QR score is the tsqr_wide* rows",
         )
         del a
 
@@ -185,6 +193,9 @@ def run():
     record(
         "lanczos", sl.per_unit_s, per="lanczos-m50",
         **sl.fields(),
+        note="reference-CI shape (n=50 f64, m=50 sequential steps): "
+             "dispatch/latency-bound by construction — ~2.6 MFLOP of "
+             "dependent matvecs; no MFU model applies",
     )
 
 

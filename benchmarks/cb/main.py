@@ -28,7 +28,7 @@ def derive(measurements):
     out = {}
     if "matmul_split_0" in by:
         n, t = config.MATMUL_N, by["matmul_split_0"]["wall_s"]
-        out["matmul_tflops"] = round(2 * n**3 / t / 1e12, 3)
+        out["matmul_tflops"] = round(config.matmul_flops(n) / t / 1e12, 3)
     if "tsqr_tall_skinny" in by:
         m, n = config.TSQR_M, config.TSQR_N
         t = by["tsqr_tall_skinny"]["wall_s"]
@@ -53,11 +53,8 @@ def derive(measurements):
         t = by["resnet50_dp_step"]["wall_s"]
         out["resnet50_img_per_s"] = round(config.RESNET_BATCH / t, 2)
         if config.RESNET_IMG == 224:
-            # 4.09 GMACs/img fwd at 224^2 → 8.18 GFLOP under the same
-            # 2-flops-per-MAC convention as every other metric here (and
-            # as the TPU peak specs); fwd+bwd ~3x fwd
             out["resnet50_tflops"] = round(
-                config.RESNET_BATCH * 3 * 2 * 4.09e9 / t / 1e12, 3
+                config.resnet50_step_flops(config.RESNET_BATCH) / t / 1e12, 3
             )
     if "resnet50_s2d_dp_step" in by:
         t = by["resnet50_s2d_dp_step"]["wall_s"]
@@ -65,13 +62,13 @@ def derive(measurements):
     if "flash_attention_forward" in by:
         bh, s, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
         t = by["flash_attention_forward"]["wall_s"]
-        # causal attention ~ 2 * (qk + pv) * 0.5 = 2*bh*s^2*d
-        out["attention_tflops"] = round(2 * bh * s * s * d / t / 1e12, 3)
+        out["attention_tflops"] = round(
+            config.attention_flops(bh, s, d, causal=True) / t / 1e12, 3)
     if "moe_ffn_forward" in by:
         tkn, dm, h = config.MOE_T, config.MOE_D, config.MOE_H
         t = by["moe_ffn_forward"]["wall_s"]
-        # top-2 routing: 2 experts/token, in+out projections
-        out["moe_tflops"] = round(2 * 2 * tkn * 2 * dm * h / t / 1e12, 3)
+        out["moe_tflops"] = round(
+            config.moe_flops(tkn, dm, h, k=2) / t / 1e12, 3)
     return out
 
 

@@ -55,6 +55,11 @@ def run():
     record(
         "reshape", sl.per_unit_s, per=f"{len(config.RESHAPE_SIZES)}-reshapes",
         **sl.fields(),
+        # pure data movement: each reshape reads + writes its array once
+        **config.hbm_fields(
+            sum(2.0 * 1000 * s * 4.0 for s in config.RESHAPE_SIZES),
+            sl.per_unit_s,
+        ),
     )
 
     a = ht.random.random((config.CONCAT_N, 64), split=0)
@@ -65,6 +70,10 @@ def run():
     record(
         "concatenate", sl.per_unit_s, per="concatenate",
         **sl.fields(),
+        # read both inputs, write the joined output: 2x the data volume
+        **config.hbm_fields(
+            2.0 * 2 * config.CONCAT_N * 64 * 4.0, sl.per_unit_s
+        ),
     )
 
     # resplit on a 1-chip mesh is a metadata relabel (the GSPMD shardings
@@ -79,6 +88,10 @@ def run():
     record(
         "resplit", sl.per_unit_s, per="resplit",
         **sl.fields(),
+        note="metadata relabel at comm.size==1 (the 1-chip shardings "
+             "coincide): a dispatch-cost row — no traffic or FLOP model "
+             "applies; the multi-chip wire structure is asserted in "
+             "SCALING_r05 (resplit_0to1: one all-to-all of the local slab)",
     )
 
 

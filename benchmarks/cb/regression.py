@@ -33,6 +33,10 @@ def run():
     record(
         "lasso_sweep", sl.per_unit_s, per="cd-sweep",
         m=m, n=n, **sl.fields(),
+        # coordinate descent is memory-bound: per sweep each of the n
+        # coordinates reads its column and reads+writes the residual
+        # (3 m-vectors) — the roofline bound, not MFU, judges this row
+        **config.hbm_fields(3.0 * m * n * 4.0, sl.per_unit_s),
     )
 
 
