@@ -1,0 +1,99 @@
+"""Local copy-paste detector (the CI twin of the driver's check).
+
+Compares every heat_tpu source against reference files that could plausibly
+be its origin — the same-named file anywhere under the reference tree plus
+any reference source within 2x of its size — using difflib's line ratio on
+comment-stripped code.  Flags ratios above the threshold (0.6, the driver's
+bar).  This framework is a ground-up TPU redesign: elevated similarity is a
+build error, not a style issue, so CI fails on any hit.
+
+Usage: python scripts/copycheck.py [--threshold 0.6] [--reference /root/reference]
+"""
+
+import argparse
+import difflib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def code_lines(path):
+    """Source lines with comments/blank lines stripped (docstrings kept:
+    sklearn-style parameter docs legitimately match — the adjudication in
+    VERDICT rounds 2-4 — but they still count toward the ratio so real
+    copies cannot hide behind them)."""
+    out = []
+    try:
+        with open(path, errors="replace") as fh:
+            for line in fh:
+                s = line.strip()
+                if s and not s.startswith("#"):
+                    out.append(s)
+    except OSError:
+        return []
+    return out
+
+
+def collect(root, exts=(".py", ".cpp", ".cc", ".h", ".hpp")):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in (".git", "__pycache__")]
+        for f in filenames:
+            if f.endswith(exts):
+                yield os.path.join(dirpath, f)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threshold", type=float, default=0.6)
+    ap.add_argument("--reference", default="/root/reference")
+    ap.add_argument("--min-lines", type=int, default=30,
+                    help="skip tiny files (init shims match trivially)")
+    args = ap.parse_args()
+
+    if not os.path.isdir(args.reference):
+        print(json.dumps({"skipped": "no reference tree", "flagged": []}))
+        return 0
+
+    ref_files = [
+        (p, code_lines(p)) for p in collect(args.reference)
+    ]
+    ref_by_name = {}
+    for p, lines in ref_files:
+        ref_by_name.setdefault(os.path.basename(p), []).append((p, lines))
+
+    flagged = []
+    checked = 0
+    for src in collect(os.path.join(REPO, "heat_tpu")):
+        lines = code_lines(src)
+        if len(lines) < args.min_lines:
+            continue
+        checked += 1
+        candidates = list(ref_by_name.get(os.path.basename(src), []))
+        lo, hi = len(lines) // 2, len(lines) * 2
+        candidates += [
+            (p, rl) for p, rl in ref_files
+            if lo <= len(rl) <= hi and os.path.basename(p) != os.path.basename(src)
+        ]
+        best, best_ref = 0.0, None
+        for p, rl in candidates:
+            if not rl:
+                continue
+            r = difflib.SequenceMatcher(None, lines, rl).ratio()
+            if r > best:
+                best, best_ref = r, p
+        if best >= args.threshold:
+            flagged.append({
+                "file": os.path.relpath(src, REPO),
+                "reference": best_ref,
+                "ratio": round(best, 3),
+            })
+
+    print(json.dumps({"checked": checked, "threshold": args.threshold,
+                      "flagged": flagged}, indent=1))
+    return 1 if flagged else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

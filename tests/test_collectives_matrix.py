@@ -481,6 +481,8 @@ class TestSubMeshCollectives(MatrixBase):
 
     def test_psum_on_submeshes(self):
         for S in (2, 4, 6):
+            if len(jax.devices()) < S:
+                continue  # CI's 4-device leg skips the 6-way submesh
             with self.subTest(S=S):
                 comm = self._submesh_comm(S)
                 host = _make((S, 3), np.float32, seed=S)

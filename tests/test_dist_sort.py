@@ -224,6 +224,8 @@ class TestColumnsort(TestCase):
 
         comm = sanitize_comm(None)
         S = comm.size
+        if S < 6:
+            self.skipTest("columnsort only dispatches at >= 6 shards")
         bound = 2 * (S - 1) ** 2
         self.assertTrue(columnsort_applicable(S, bound))
         self.assertFalse(columnsort_applicable(S, (bound - S) // 2))
@@ -299,6 +301,9 @@ class TestColumnsort(TestCase):
         of all-to-alls and collective-permutes on a 6-device submesh as on
         the full 8 (the odd-even network's census grows linearly)."""
         import jax
+
+        if len(jax.devices()) < 8:
+            self.skipTest("needs the 8-device mesh")
         import jax.numpy as jnp
         from jax.sharding import Mesh
 
@@ -695,6 +700,9 @@ class TestColumnsortOddSubmeshes(TestCase):
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh
+
+        if len(jax.devices()) < S:
+            self.skipTest(f"needs a {S}-device mesh")
 
         from heat_tpu.parallel.mesh import MeshComm
         from heat_tpu.parallel.sort import distributed_sort
