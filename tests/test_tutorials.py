@@ -1,0 +1,38 @@
+"""Tutorials must run top to bottom (round 5; VERDICT r4 #8).
+
+Extracts every ```python block from docs/tutorial_30_minutes.md and
+docs/tutorial_clustering.md and executes them in order in one shared
+namespace per document — the markdown IS the test vector, so a doc edit
+that breaks a snippet fails CI, and a new user can paste any prefix of a
+tutorial and have it work.
+"""
+
+import os
+import re
+
+from .base import TestCase
+
+DOCS = os.path.join(os.path.dirname(__file__), "..", "docs")
+
+
+def python_blocks(path):
+    text = open(path, encoding="utf-8").read()
+    return re.findall(r"```python\n(.*?)```", text, re.S)
+
+
+class TestTutorials(TestCase):
+    def _run_doc(self, name):
+        blocks = python_blocks(os.path.join(DOCS, name))
+        self.assertGreater(len(blocks), 3, f"{name} lost its code blocks")
+        ns = {}
+        for i, block in enumerate(blocks):
+            try:
+                exec(compile(block, f"{name}[block {i}]", "exec"), ns)
+            except Exception as e:
+                self.fail(f"{name} block {i} failed: {e}\n---\n{block}")
+
+    def test_tutorial_30_minutes(self):
+        self._run_doc("tutorial_30_minutes.md")
+
+    def test_tutorial_clustering(self):
+        self._run_doc("tutorial_clustering.md")
