@@ -5,10 +5,43 @@ from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 
 from ..core.dndarray import DNDarray, _ensure_split
 from ..core import types
+
+
+def _no_self_loops(A):
+    """Zero the diagonal (traced): the iota compare fuses into the select —
+    eager, ``jnp.diag(jnp.diagonal(A))`` materialized an O(n^2) temporary
+    on a split adjacency (round-5 global-temporary sweep)."""
+    i = jax.lax.broadcasted_iota(jnp.int32, A.shape, 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, A.shape, 1)
+    return jnp.where(i == j, jnp.zeros((), A.dtype), A)
+
+
+@jax.jit
+def _norm_sym_L(A):
+    """Self-loop removal + L_sym = I − D^-1/2 A D^-1/2 (reference:
+    laplacian.py:81).  One jitted program: the identity's iota and the
+    diagonal zeroing fuse into the elementwise selects — eager, the
+    ``jnp.eye(n)``/``jnp.diag`` pair materialized replicated O(n^2)
+    temporaries on a split adjacency (round-5 global-temporary sweep)."""
+    A = _no_self_loops(A)
+    degree = jnp.sum(A, axis=1)
+    d_inv_sqrt = jnp.where(degree > 0, 1.0 / jnp.sqrt(degree), 0.0)
+    L = -A * d_inv_sqrt[:, None] * d_inv_sqrt[None, :]
+    return L + jnp.eye(A.shape[0], dtype=A.dtype)
+
+
+@jax.jit
+def _simple_L_jit(A):
+    """Self-loop removal + L = D − A (reference: laplacian.py:106), fused
+    for the same reason."""
+    A = _no_self_loops(A)
+    degree = jnp.sum(A, axis=1)
+    return jnp.diag(degree) - A
 
 __all__ = ["Laplacian"]
 
@@ -65,17 +98,12 @@ class Laplacian:
         self.neighbours = neighbours
 
     def _normalized_symmetric_L(self, A):
-        """L_sym = I − D^-1/2 A D^-1/2 (reference: laplacian.py:81)."""
-        degree = jnp.sum(A, axis=1)
-        d_inv_sqrt = jnp.where(degree > 0, 1.0 / jnp.sqrt(degree), 0.0)
-        L = -A * d_inv_sqrt[:, None] * d_inv_sqrt[None, :]
-        L = L + jnp.eye(A.shape[0], dtype=A.dtype)
-        return L
+        """L_sym = I − D^-1/2 A D^-1/2 (see :func:`_norm_sym_L`)."""
+        return _norm_sym_L(A)
 
     def _simple_L(self, A):
-        """L = D − A (reference: laplacian.py:106)."""
-        degree = jnp.sum(A, axis=1)
-        return jnp.diag(degree) - A
+        """L = D − A (see :func:`_simple_L_jit`)."""
+        return _simple_L_jit(A)
 
     def construct(self, X: DNDarray) -> DNDarray:
         """Build the Laplacian of the dataset (reference: laplacian.py:118)."""
@@ -88,8 +116,7 @@ class Laplacian:
             else:
                 keep = A > value
             A = jnp.where(keep, A if self.weighted else jnp.ones_like(A), 0.0)
-        # no self-loops
-        A = A - jnp.diag(jnp.diagonal(A))
+        # self-loop removal happens inside the jitted L builders
         L = self._normalized_symmetric_L(A) if self.definition == "norm_sym" else self._simple_L(A)
         out = DNDarray(
             L, tuple(L.shape), types.canonical_heat_type(L.dtype), S.split, X.device, X.comm
