@@ -153,3 +153,51 @@ class TestStructuralCensus(TestCase):
         c = census(jax.jit(mm(self.comm.spec(None, 2))),
                    self._sharded((m, m), 1), self._sharded((m, m), 0))
         self.assertEqual(c["all-reduce"]["count"], 1)  # inner contraction
+
+
+@unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+class TestIntGatherCensus(TestCase):
+    """The routed x[rows]/x[rows, cols] class (round 5; VERDICT r4 #5):
+    one reduce-scatter of the OUTPUT volume, no input-sized buffer in the
+    compiled program."""
+
+    def test_one_reduce_scatter_output_volume(self):
+        from heat_tpu.parallel.select import _jit_int_gather
+
+        comm = self.comm
+        n, f = 4096, 32
+        phys = jax.device_put(
+            jnp.zeros((n, f), jnp.float32), comm.sharding(0, 2)
+        )
+        n_out = 1000
+        per_out = -(-n_out // comm.size)
+        rows = jnp.zeros((comm.size * per_out,), jnp.int32)
+        fn = _jit_int_gather(comm.mesh, comm.split_axis, 0, 2, per_out)
+        text = fn.lower(phys, rows).compile().as_text()
+        import re
+        c = hlo_census(text)
+        self.assertEqual(c["reduce-scatter"]["count"], 1)
+        self.assertEqual(
+            c["reduce-scatter"]["bytes_out"], per_out * f * 4)
+        self.assertNotIn("all-gather", c)
+        # no input-sized f32 buffer: the biggest live f32 is the output
+        # staging (S*per_out rows), far below the global input
+        shapes = [
+            int(np.prod([int(d) for d in m[4:-1].split(",")]))
+            for m in set(re.findall(r"f32\[[\d,]+\]", text))
+        ]
+        self.assertLess(max(shapes), n * f // 2)
+
+    def test_pair_take_is_collective_free(self):
+        from heat_tpu.parallel.select import _jit_pair_take
+
+        comm = self.comm
+        per_out = 128
+        phys = jax.device_put(
+            jnp.zeros((per_out * comm.size, 16), jnp.float32),
+            comm.sharding(0, 2),
+        )
+        cols = jnp.zeros((per_out * comm.size,), jnp.int32)
+        fn = _jit_pair_take(comm.mesh, comm.split_axis, 0, 1, 2)
+        c = hlo_census(fn.lower(phys, cols).compile().as_text())
+        self.assertEqual(c, {})  # purely local pairing
