@@ -704,3 +704,486 @@ class TestScalarBoolAdvancedBlock(TestCase):
                     x[np.array(True)], host[np.array(True)])
                 self.assert_array_equal(
                     x[:, np.array(True)], host[:, np.array(True)])
+
+
+class TestSetitemSliceMatrix(TestCase):
+    """Negative-step / negative-bound slice assignment at reference depth
+    (heat/core/tests/test_dndarray.py's setitem matrix)."""
+
+    SLICES_1D = [
+        slice(None), slice(2, 9), slice(-5, None), slice(None, -3),
+        slice(None, None, 2), slice(None, None, -1), slice(9, 2, -1),
+        slice(-2, 1, -2), slice(11, None, -3), slice(5, 5),
+    ]
+
+    def test_scalar_into_1d_slices(self):
+        host = np.arange(13, dtype=np.float32)
+        for s in (None, 0):
+            for sl in self.SLICES_1D:
+                with self.subTest(split=s, sl=sl):
+                    x = ht.array(host, split=s)
+                    e = host.copy()
+                    x[sl] = -7.0
+                    e[sl] = -7.0
+                    self.assert_array_equal(x, e)
+
+    def test_vector_into_1d_slices(self):
+        host = np.arange(13, dtype=np.float32)
+        for s in (None, 0):
+            for sl in self.SLICES_1D:
+                want = len(range(*sl.indices(13)))
+                if want == 0:
+                    continue
+                with self.subTest(split=s, sl=sl):
+                    x = ht.array(host, split=s)
+                    e = host.copy()
+                    v = np.linspace(100, 200, want).astype(np.float32)
+                    x[sl] = v
+                    e[sl] = v
+                    self.assert_array_equal(x, e)
+
+    PAIRS_2D = [
+        (slice(None, None, -1), slice(None)),
+        (slice(2, 11, 2), slice(1, 6)),
+        (slice(-1, 2, -3), slice(None, None, -2)),
+        (slice(None), slice(6, 0, -1)),
+        (slice(10, None, -2), slice(-3, None)),
+        (slice(12, 0, -4), slice(0, 7, 3)),
+    ]
+
+    def test_2d_mixed_slice_pairs(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for s in (None, 0, 1):
+            for key in self.PAIRS_2D:
+                with self.subTest(split=s, key=key):
+                    x = ht.array(host, split=s)
+                    e = host.copy()
+                    x[key] = 0.5
+                    e[key] = 0.5
+                    self.assert_array_equal(x, e)
+
+    def test_block_into_reversed_rows(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        block = np.arange(21, dtype=np.float32).reshape(3, 7) * -1
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[8:2:-2] = block
+                e[8:2:-2] = block
+                self.assert_array_equal(x, e)
+
+
+class TestSetitemCrossSplitValues(TestCase):
+    """DNDarray values whose split differs from the target's (reference:
+    cross-split value assignment, test_dndarray.py)."""
+
+
+    def test_row_from_other_array(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        other = ht.array(host * 10, split=0)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                x[0] = other[12]
+                e = host.copy()
+                e[0] = host[12] * 10
+                self.assert_array_equal(x, e)
+
+    def test_column_cross_split(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        col = ht.array(np.full(13, 9.0, np.float32), split=0)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                x[:, 2] = col
+                e = host.copy()
+                e[:, 2] = 9.0
+                self.assert_array_equal(x, e)
+
+
+class TestSetitemAdvancedBroadcast(TestCase):
+    """Scalar/array broadcast onto advanced keys (reference:
+    test_dndarray.py's advanced setitem block)."""
+
+    def test_scalar_onto_int_array_key(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        rows = np.array([0, 5, 12, -1, 3])
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[rows] = 3.25
+                e[rows] = 3.25
+                self.assert_array_equal(x, e)
+
+    def test_row_vector_broadcast_onto_rows(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        rows = np.array([2, 7, 11])
+        v = np.arange(7, dtype=np.float32) * -2
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[rows] = v           # (7,) broadcast over 3 rows
+                e[rows] = v
+                self.assert_array_equal(x, e)
+
+    def test_full_block_onto_rows(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        rows = np.array([1, 4, 9])
+        block = np.arange(21, dtype=np.float32).reshape(3, 7) + 100
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[rows] = block
+                e[rows] = block
+                self.assert_array_equal(x, e)
+
+    def test_vector_onto_paired_keys(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        rows = np.array([0, 6, 12])
+        cols = np.array([1, 0, -1])
+        vals = np.array([10.0, 20.0, 30.0], np.float32)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[rows, cols] = vals
+                e[rows, cols] = vals
+                self.assert_array_equal(x, e)
+
+    def test_scalar_onto_mask_selection(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        mask = (host % 5) == 0
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[mask] = 0.0
+                e[mask] = 0.0
+                self.assert_array_equal(x, e)
+
+    def test_column_key_with_slice(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        cols = np.array([0, 3, -2])
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[2:9, cols] = -1.0
+                e[2:9, cols] = -1.0
+                self.assert_array_equal(x, e)
+
+    def test_dtype_cast_on_assign(self):
+        host = np.arange(20, dtype=np.float32).reshape(4, 5)
+        x = ht.array(host, split=0)
+        x[1] = np.arange(5)           # int value into float target
+        e = host.copy()
+        e[1] = np.arange(5)
+        self.assert_array_equal(x, e)
+        self.assertIs(x.dtype, ht.float32)
+
+
+class TestSetitemChainedAndAugmented(TestCase):
+    def test_augmented_on_slice(self):
+        host = np.arange(13, dtype=np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[2:9] += 10.0
+                e[2:9] += 10.0
+                self.assert_array_equal(x, e)
+
+    def test_augmented_on_rows_2d(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[3:6] *= 2.0
+                e[3:6] *= 2.0
+                self.assert_array_equal(x, e)
+
+    def test_sequential_overlapping_writes(self):
+        host = np.zeros(29, np.float32)
+        x = ht.array(host, split=0)
+        e = host.copy()
+        for lo, hi, v in ((0, 15, 1.0), (10, 25, 2.0), (20, 29, 3.0)):
+            x[lo:hi] = v
+            e[lo:hi] = v
+        self.assert_array_equal(x, e)
+
+    def test_write_then_reduce(self):
+        # pad hygiene: a write followed by a split-axis reduction must not
+        # see stale or leaked pad values
+        host = np.arange(13, dtype=np.float32)
+        x = ht.array(host, split=0)
+        x[5:] = 1.0
+        e = host.copy()
+        e[5:] = 1.0
+        self.assertEqual(float(x.sum()), float(e.sum()))
+        self.assertEqual(float(x.max()), float(e.max()))
+
+
+class TestSetitemEmptyAndEdge(TestCase):
+    def test_empty_slice_is_noop(self):
+        host = np.arange(13, dtype=np.float32)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                x[5:5] = 99.0
+                self.assert_array_equal(x, host)
+
+    def test_empty_int_array_is_noop(self):
+        host = np.arange(13, dtype=np.float32)
+        x = ht.array(host, split=0)
+        x[np.array([], np.int64)] = 99.0
+        self.assert_array_equal(x, host)
+
+    def test_setitem_oob_int_raises(self):
+        x = ht.array(np.zeros(5, np.float32), split=0)
+        with self.assertRaises(IndexError):
+            x[7] = 1.0
+        with self.assertRaises(IndexError):
+            x[-6] = 1.0
+
+    def test_setitem_oob_array_raises(self):
+        x = ht.array(np.zeros((5, 3), np.float32), split=0)
+        with self.assertRaises(IndexError):
+            x[np.array([0, 5])] = 1.0
+
+    def test_ellipsis_setitem(self):
+        host = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        for s in _splits(3):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[..., 1] = -5.0
+                e[..., 1] = -5.0
+                self.assert_array_equal(x, e)
+                x[0, ...] = 7.0
+                e[0, ...] = 7.0
+                self.assert_array_equal(x, e)
+
+    def test_newaxis_setitem_fallback(self):
+        host = np.arange(12, dtype=np.float32).reshape(4, 3)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[None, 2] = 1.5
+                e[None, 2] = 1.5
+                self.assert_array_equal(x, e)
+
+    def test_iteration_protocol_after_writes(self):
+        host = np.arange(15, dtype=np.float32).reshape(5, 3)
+        x = ht.array(host, split=0)
+        x[2] = 0.0
+        e = host.copy()
+        e[2] = 0.0
+        rows = [r.numpy() for r in x]
+        self.assertEqual(len(rows), 5)
+        for got, exp in zip(rows, e):
+            np.testing.assert_array_equal(got, exp)
+
+
+class TestGetitemSliceMatrixDeep(TestCase):
+    """Negative-step / negative-bound GETITEM matrix mirroring the setitem
+    classes above (reference: test_dndarray.py's slice tables)."""
+
+
+    def test_2d_pair_table(self):
+        # same table as the setitem matrix (one literal, two directions)
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for s in (None, 0, 1):
+            for key in TestSetitemSliceMatrix.PAIRS_2D:
+                with self.subTest(split=s, key=key):
+                    x = ht.array(host, split=s)
+                    self.assert_array_equal(x[key], host[key])
+
+    def test_get_then_set_composition(self):
+        # rows 0..5 get rows 1,3,5,7,9,11's values — a sharded get feeding
+        # a sharded set on the same array
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                e = host.copy()
+                x[0:6] = x[1::2]
+                e[0:6] = e[1::2]
+                self.assert_array_equal(x, e)
+
+
+class TestScalarCastsAndProtocols(TestCase):
+    """Only the case TestDNDarraySurface doesn't already cover: a fully
+    consumed key returns a replicated 0-d DNDarray for every input split."""
+
+    def test_scalar_getitem_returns_0d(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                got = x[4, 5]
+                self.assertEqual(got.ndim, 0)
+                self.assertIsNone(got.split)
+                self.assertEqual(float(got), host[4, 5])
+
+
+class TestSetitemThreeDMatrix(TestCase):
+    """3-D setitem across every split: the reference's matrix includes the
+    higher-rank combinations where split-offset bookkeeping breaks."""
+
+    def setUp(self):
+        self.host = np.arange(210, dtype=np.float32).reshape(7, 5, 6)
+
+    def test_plane_assignment(self):
+        for s in _splits(3):
+            with self.subTest(split=s):
+                x = ht.array(self.host, split=s)
+                e = self.host.copy()
+                x[3] = -1.0
+                e[3] = -1.0
+                self.assert_array_equal(x, e)
+
+    def test_middle_axis_slab(self):
+        for s in _splits(3):
+            with self.subTest(split=s):
+                x = ht.array(self.host, split=s)
+                e = self.host.copy()
+                x[:, 1:4] = 0.25
+                e[:, 1:4] = 0.25
+                self.assert_array_equal(x, e)
+
+    def test_reversed_last_axis(self):
+        for s in _splits(3):
+            with self.subTest(split=s):
+                x = ht.array(self.host, split=s)
+                e = self.host.copy()
+                v = np.arange(6, dtype=np.float32)
+                x[2, 3, ::-1] = v
+                e[2, 3, ::-1] = v
+                self.assert_array_equal(x, e)
+
+    def test_block_cross_split_value_3d(self):
+        block = -np.arange(60, dtype=np.float32).reshape(2, 5, 6)
+        for st in _splits(3):
+            for sv in _splits(3):
+                with self.subTest(target=st, value=sv):
+                    x = ht.array(self.host, split=st)
+                    v = ht.array(block, split=sv)
+                    e = self.host.copy()
+                    x[4:6] = v
+                    e[4:6] = block
+                    self.assert_array_equal(x, e)
+
+    def test_int_array_on_each_axis(self):
+        idx = np.array([0, 4, 2])
+        for axis in range(3):
+            for s in _splits(3):
+                with self.subTest(axis=axis, split=s):
+                    x = ht.array(self.host, split=s)
+                    e = self.host.copy()
+                    key = tuple(
+                        idx if d == axis else slice(None) for d in range(axis + 1)
+                    )
+                    x[key] = 5.5
+                    e[key] = 5.5
+                    self.assert_array_equal(x, e)
+
+
+class TestSetitemResplitInteractions(TestCase):
+    """Writes composed with redistribution: the physical-layout scatter
+    must stay correct across resplits and halo invalidation (reference:
+    test_dndarray.py exercises setitem on freshly-resplit arrays)."""
+
+    def test_write_resplit_write(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        x = ht.array(host, split=0)
+        e = host.copy()
+        x[0] = -1.0
+        e[0] = -1.0
+        x.resplit_(1)
+        x[:, 3] = -2.0
+        e[:, 3] = -2.0
+        self.assertEqual(x.split, 1)
+        self.assert_array_equal(x, e)
+        x.resplit_(0)
+        x[-1] = -3.0
+        e[-1] = -3.0
+        self.assert_array_equal(x, e)
+
+    def test_write_after_gather(self):
+        host = np.arange(26, dtype=np.float32).reshape(13, 2)
+        x = ht.array(host, split=0)
+        x.resplit_(None)
+        x[4:9] = 0.0
+        e = host.copy()
+        e[4:9] = 0.0
+        self.assertIsNone(x.split)
+        self.assert_array_equal(x, e)
+
+    def test_halo_refresh_after_write(self):
+        # convolve consumes halos; a preceding setitem must invalidate them
+        host = np.zeros(29, np.float32)
+        kernel = np.array([1.0, 1.0, 1.0], np.float32)
+        x = ht.array(host, split=0)
+        _ = ht.convolve(x, ht.array(kernel), mode="same")  # builds halos
+        x[10:20] = 1.0
+        got = ht.convolve(x, ht.array(kernel), mode="same")
+        e = host.copy()
+        e[10:20] = 1.0
+        self.assert_array_equal(got, np.convolve(e, kernel, mode="same"))
+
+    def test_dndarray_mask_setitem(self):
+        host = np.arange(29, dtype=np.float32)
+        x = ht.array(host, split=0)
+        mask = x > 20                # DNDarray mask, itself split
+        x[mask] = -1.0
+        e = host.copy()
+        e[host > 20] = -1.0
+        self.assert_array_equal(x, e)
+
+    def test_dndarray_int_key_setitem(self):
+        host = np.arange(29, dtype=np.float32)
+        x = ht.array(host, split=0)
+        key = ht.array(np.array([0, 7, 28]), split=0)
+        x[key] = 5.0
+        e = host.copy()
+        e[[0, 7, 28]] = 5.0
+        self.assert_array_equal(x, e)
+
+
+class TestViewChainsAndWrites(TestCase):
+    """Chained views feeding writes: slices of slices, writes through
+    freshly-sliced unbalanced results, transposed targets."""
+
+    def test_getitem_chain(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s)
+                self.assert_array_equal(x[2:][3], host[2:][3])
+                self.assert_array_equal(x[1:12][::2, 1:], host[1:12][::2, 1:])
+
+    def test_write_into_sliced_copy_leaves_parent(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        x = ht.array(host, split=0)
+        y = x[3:9]          # a COPY in this model (jax arrays are immutable)
+        y[0] = -1.0
+        self.assert_array_equal(x, host)  # parent untouched
+        e = host[3:9].copy()
+        e[0] = -1.0
+        self.assert_array_equal(y, e)
+
+    def test_transpose_then_write(self):
+        host = np.arange(91, dtype=np.float32).reshape(13, 7)
+        for s in (None, 0, 1):
+            with self.subTest(split=s):
+                x = ht.array(host, split=s).T
+                e = host.T.copy()
+                x[2] = 0.0
+                e[2] = 0.0
+                self.assert_array_equal(x, e)

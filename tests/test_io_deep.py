@@ -22,7 +22,10 @@ def _splits(ndim):
 
 class IOBase(TestCase):
     def setUp(self):
+        import shutil
+
         self.dir = tempfile.mkdtemp()
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
 
     def path(self, name):
         return os.path.join(self.dir, name)

@@ -1,0 +1,378 @@
+"""IO option matrix at reference depth (round 5; VERDICT r4 #4a).
+
+The reference's ``test_io.py`` (1,121 LoC) exhausts load/save options:
+dtype x split x slicing-on-load x compression/chunking x append modes x
+failure modes.  This file extends the existing io suites with exactly
+those axes; every load is asserted at the value level against the written
+host data AND at the distribution level (``assert_array_equal``'s
+per-shard slab check), because byte-range math is where slab loaders
+corrupt silently.  Reference model: heat/core/tests/test_io.py:1.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core import io as htio
+from .test_io_deep import IOBase as IOMatrixBase
+
+
+def _splits(ndim):
+    return [None] + list(range(ndim))
+
+
+class TestHDF5SlicingOnLoad(IOMatrixBase):
+    """slices= on load: the slab reader must compose the user slice with
+    the per-shard chunk (reference: load_hdf5's slicing options)."""
+
+    def setUp(self):
+        super().setUp()
+        if not htio.supports_hdf5():
+            self.skipTest("h5py not available")
+        self.host = np.arange(23 * 9, dtype=np.float32).reshape(23, 9)
+        self.p = self.path("sl.h5")
+        ht.save(ht.array(self.host, split=0), self.p, "data")
+
+    def test_single_slice_every_split(self):
+        for s in _splits(2):
+            with self.subTest(split=s):
+                got = ht.load_hdf5(self.p, "data", split=s,
+                                   slices=slice(3, 17))
+                self.assert_array_equal(got, self.host[3:17])
+
+    def test_tuple_slices(self):
+        for s in _splits(2):
+            with self.subTest(split=s):
+                got = ht.load_hdf5(self.p, "data", split=s,
+                                   slices=(slice(2, 20), slice(1, 8)))
+                self.assert_array_equal(got, self.host[2:20, 1:8])
+
+    def test_stepped_slice_on_split_dim(self):
+        for s in _splits(2):
+            with self.subTest(split=s):
+                got = ht.load_hdf5(self.p, "data", split=s,
+                                   slices=slice(1, 22, 3))
+                self.assert_array_equal(got, self.host[1:22:3])
+
+    def test_none_entries_mean_full_dim(self):
+        got = ht.load_hdf5(self.p, "data", split=1,
+                           slices=(None, slice(0, 5)))
+        self.assert_array_equal(got, self.host[:, 0:5])
+
+    def test_open_ended_slices(self):
+        got = ht.load_hdf5(self.p, "data", split=0, slices=slice(7, None))
+        self.assert_array_equal(got, self.host[7:])
+        got = ht.load_hdf5(self.p, "data", split=0, slices=slice(None, 4))
+        self.assert_array_equal(got, self.host[:4])
+
+    def test_slice_to_single_row(self):
+        got = ht.load_hdf5(self.p, "data", split=0, slices=slice(5, 6))
+        self.assert_array_equal(got, self.host[5:6])
+
+
+
+class TestHDF5OptionMatrix(IOMatrixBase):
+    def setUp(self):
+        super().setUp()
+        if not htio.supports_hdf5():
+            self.skipTest("h5py not available")
+
+    def test_compression_chunking_kwargs(self):
+        # save kwargs pass through to h5py's create_dataset
+        host = np.arange(64 * 6, dtype=np.float32).reshape(64, 6)
+        for kwargs in (
+            {"compression": "gzip"},
+            {"compression": "gzip", "compression_opts": 6},
+            {"chunks": (8, 6)},
+            {"chunks": True, "compression": "lzf"},
+        ):
+            with self.subTest(kwargs=kwargs):
+                p = self.path(f"c_{'_'.join(map(str, kwargs))}.h5")
+                ht.save_hdf5(ht.array(host, split=0), p, "data", **kwargs)
+                got = ht.load(p, dataset="data", split=0)
+                self.assert_array_equal(got, host)
+
+    def test_append_mode_adds_dataset(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.arange(10, dtype=np.float32)
+        p = self.path("a.h5")
+        ht.save_hdf5(ht.array(a, split=0), p, "first", mode="w")
+        ht.save_hdf5(ht.array(b, split=0), p, "second", mode="a")
+        self.assert_array_equal(ht.load(p, dataset="first"), a)
+        self.assert_array_equal(ht.load(p, dataset="second"), b)
+
+    def test_write_mode_truncates(self):
+        a = np.ones((4, 4), np.float32)
+        p = self.path("w.h5")
+        ht.save_hdf5(ht.array(a), p, "old", mode="w")
+        ht.save_hdf5(ht.array(a * 2), p, "new", mode="w")
+        with self.assertRaises(KeyError):
+            ht.load(p, dataset="old")
+        self.assert_array_equal(ht.load(p, dataset="new"), a * 2)
+
+    def test_load_dtype_coercion_matrix(self):
+        host = np.arange(40, dtype=np.float64).reshape(8, 5)
+        p = self.path("d.h5")
+        ht.save(ht.array(host, split=0), p, "data")
+        for want in (ht.float32, ht.float64, ht.int32, ht.int64):
+            for s in _splits(2):
+                with self.subTest(dtype=want, split=s):
+                    got = ht.load(p, dataset="data", dtype=want, split=s)
+                    self.assertIs(got.dtype, want)
+                    self.assert_array_equal(
+                        got, host.astype(np.dtype(want.jax_type()))
+                    )
+
+    def test_three_d_split2_roundtrip(self):
+        host = np.arange(5 * 6 * 11, dtype=np.float32).reshape(5, 6, 11)
+        p = self.path("t3.h5")
+        ht.save(ht.array(host, split=2), p, "data")
+        for s in _splits(3):
+            with self.subTest(split=s):
+                self.assert_array_equal(
+                    ht.load(p, dataset="data", split=s), host)
+
+    def test_one_d_and_scalar_edge(self):
+        host = np.arange(17, dtype=np.float32)
+        p = self.path("v.h5")
+        ht.save(ht.array(host, split=0), p, "data")
+        self.assert_array_equal(ht.load(p, dataset="data", split=0), host)
+        # genuine scalar dataset: 0-d roundtrip through the h5 path
+        import h5py
+
+        ps = self.path("s.h5")
+        with h5py.File(ps, "w") as fh:
+            fh.create_dataset("s", data=np.float32(4.25))
+        got = ht.load(ps, dataset="s")
+        self.assertEqual(got.ndim, 0)
+        self.assertEqual(float(got), 4.25)
+
+    def test_sliced_load_of_3d_every_split(self):
+        host = np.arange(4 * 9 * 5, dtype=np.float32).reshape(4, 9, 5)
+        p = self.path("s3.h5")
+        ht.save(ht.array(host, split=1), p, "data")
+        key = (slice(1, 4), slice(2, 8, 2), slice(None, None, 2))
+        for s in _splits(3):
+            with self.subTest(split=s):
+                got = ht.load_hdf5(p, "data", split=s, slices=key)
+                self.assert_array_equal(got, host[key])
+
+
+class TestIOFailureModes(IOMatrixBase):
+    """Corruption cases only — the missing-file/dataset/extension branches
+    live in test_io_errors.py; duplicating them here would triple-maintain
+    the same assertions."""
+
+    def test_truncated_hdf5_raises(self):
+        if not htio.supports_hdf5():
+            self.skipTest("h5py not available")
+        p = self.path("t.h5")
+        ht.save(ht.ones((32, 8), split=0), p, "data")
+        size = os.path.getsize(p)
+        with open(p, "r+b") as fh:
+            fh.truncate(size // 3)
+        with self.assertRaises((OSError, KeyError)):
+            ht.load(p, dataset="data", split=0)
+
+    def test_garbage_bytes_raise(self):
+        p = self.path("g.h5")
+        with open(p, "wb") as fh:
+            fh.write(b"this is not an hdf5 file at all" * 4)
+        with self.assertRaises((OSError, RuntimeError)):
+            ht.load(p, dataset="data")
+
+    def test_truncated_npy_raises(self):
+        p = self.path("t.npy")
+        ht.save(ht.arange(1000, split=0), p)
+        with open(p, "r+b") as fh:
+            fh.truncate(os.path.getsize(p) // 2)
+        with self.assertRaises((ValueError, OSError)):
+            ht.load(p, split=0)
+
+
+
+class TestCSVMatrix(IOMatrixBase):
+    def test_sep_header_dtype_matrix(self):
+        host = np.arange(19 * 4, dtype=np.float32).reshape(19, 4)
+        for sep in (",", ";", "\t"):
+            for header in (0, 2):
+                for s in (None, 0):
+                    with self.subTest(sep=sep, header=header, split=s):
+                        p = self.path(f"c{ord(sep)}_{header}.csv")
+                        with open(p, "w") as fh:
+                            for _ in range(header):
+                                fh.write("# header line\n")
+                            for row in host:
+                                fh.write(sep.join(f"{v:.1f}" for v in row) + "\n")
+                        got = ht.load_csv(p, sep=sep, header_lines=header, split=s)
+                        self.assert_array_equal(got, host)
+
+    def test_save_csv_roundtrip_splits(self):
+        host = np.arange(23 * 3, dtype=np.float32).reshape(23, 3)
+        for s in (None, 0):
+            with self.subTest(split=s):
+                p = self.path(f"rt_{s}.csv")
+                ht.save(ht.array(host, split=s), p)
+                self.assert_array_equal(ht.load(p, split=0), host)
+
+    def test_int_dtype_load(self):
+        host = np.arange(30, dtype=np.int64).reshape(10, 3)
+        p = self.path("i.csv")
+        with open(p, "w") as fh:
+            for row in host:
+                fh.write(",".join(str(v) for v in row) + "\n")
+        got = ht.load_csv(p, dtype=ht.int64, split=0)
+        self.assertIs(got.dtype, ht.int64)
+        self.assert_array_equal(got, host)
+
+
+class TestNetCDFMatrix(IOMatrixBase):
+    def setUp(self):
+        super().setUp()
+        if not htio.supports_netcdf():
+            self.skipTest("no NetCDF backend")
+
+    def test_roundtrip_dtype_split(self):
+        rng = np.random.default_rng(7)
+        for dt in (np.float32, np.float64):
+            host = rng.standard_normal((11, 6)).astype(dt)
+            for s in _splits(2):
+                with self.subTest(dtype=dt, split=s):
+                    p = self.path(f"n_{np.dtype(dt).name}_{s}.nc")
+                    ht.save(ht.array(host, split=s), p, "var")
+                    got = ht.load(p, variable="var",
+                                  dtype=ht.types.canonical_heat_type(dt),
+                                  split=s)
+                    self.assert_array_equal(got, host)
+
+    def test_missing_variable_raises(self):
+        p = self.path("mv.nc")
+        ht.save(ht.ones((4, 3), split=0), p, "present")
+        with self.assertRaises(KeyError):
+            ht.load(p, variable="absent")
+
+
+class TestNpyMatrix(IOMatrixBase):
+    def test_roundtrip_matrix(self):
+        rng = np.random.default_rng(11)
+        for dt in (np.float32, np.float64, np.int32):
+            for shape in ((17,), (13, 5), (3, 4, 7)):
+                host = (rng.standard_normal(shape) * 9).astype(dt)
+                for s in _splits(len(shape)):
+                    with self.subTest(dtype=dt, shape=shape, split=s):
+                        p = self.path(
+                            f"n_{np.dtype(dt).name}_{len(shape)}_{s}.npy")
+                        ht.save(ht.array(host, split=s), p)
+                        got = ht.load(p, split=s)
+                        self.assert_array_equal(got, host)
+                        self.assertEqual(got.split, s)
+
+    def test_fortran_order_file(self):
+        host = np.asfortranarray(np.arange(20, dtype=np.float32).reshape(4, 5))
+        p = self.path("f.npy")
+        np.save(p, host)
+        got = ht.load(p, split=0)
+        self.assert_array_equal(got, np.ascontiguousarray(host))
+
+
+class TestCSVEdgeFormats(IOMatrixBase):
+    def test_scientific_and_negative_values(self):
+        host = np.array(
+            [[-1.5e-8, 2.25e6, -0.0], [3.125e-2, -7.75e3, 1.0]], np.float64
+        )
+        p = self.path("sci.csv")
+        with open(p, "w") as fh:
+            for row in host:
+                fh.write(",".join(repr(float(v)) for v in row) + "\n")
+        got = ht.load_csv(p, dtype=ht.float64, split=0)
+        self.assert_array_equal(got, host)
+
+    def test_no_trailing_newline(self):
+        host = np.arange(12, dtype=np.float32).reshape(4, 3)
+        p = self.path("nt.csv")
+        with open(p, "w") as fh:
+            body = "\n".join(",".join(f"{v:.1f}" for v in r) for r in host)
+            fh.write(body)  # no final \n
+        got = ht.load_csv(p, split=0)
+        self.assert_array_equal(got, host)
+
+    def test_blank_trailing_lines(self):
+        host = np.arange(9, dtype=np.float32).reshape(3, 3)
+        p = self.path("bl.csv")
+        with open(p, "w") as fh:
+            for r in host:
+                fh.write(",".join(f"{v:.1f}" for v in r) + "\n")
+            fh.write("\n\n")
+        got = ht.load_csv(p, split=0)
+        self.assert_array_equal(got, host)
+
+
+
+class TestHDF5ViewsAndDtypes(IOMatrixBase):
+    def setUp(self):
+        super().setUp()
+        if not htio.supports_hdf5():
+            self.skipTest("h5py not available")
+
+    def test_save_sliced_view(self):
+        # a non-contiguous logical view must serialize its VALUES, not its
+        # physical parent
+        host = np.arange(20 * 6, dtype=np.float32).reshape(20, 6)
+        x = ht.array(host, split=0)
+        p = self.path("view.h5")
+        ht.save(x[3:17:2], p, "data")
+        self.assert_array_equal(ht.load(p, dataset="data"), host[3:17:2])
+
+    def test_save_bool_roundtrip(self):
+        host = (np.arange(24).reshape(8, 3) % 3 == 0)
+        p = self.path("b.h5")
+        ht.save(ht.array(host, split=0), p, "data")
+        got = ht.load(p, dataset="data", dtype=ht.bool, split=0)
+        self.assert_array_equal(got, host)
+
+    def test_save_after_inplace_mutation(self):
+        # halo/pad caches must not leak stale slabs into the writer
+        host = np.arange(26, dtype=np.float32).reshape(13, 2)
+        x = ht.array(host, split=0)
+        x[4:9] = -1.0
+        p = self.path("mut.h5")
+        ht.save(x, p, "data")
+        e = host.copy()
+        e[4:9] = -1.0
+        self.assert_array_equal(ht.load(p, dataset="data", split=0), e)
+
+
+class TestCSVSaveOptions(IOMatrixBase):
+    """save_csv option coverage: header_lines, sep, decimals, append
+    (truncate=False) — the reference's save path options (io.py:926)."""
+
+    def test_header_sep_decimals(self):
+        host = np.array([[1.125, -2.5], [3.0625, 4.75]], np.float32)
+        p = self.path("hdr.csv")
+        ht.save_csv(ht.array(host, split=0), p,
+                    header_lines=["# col_a;col_b"], sep=";", decimals=4)
+        lines = open(p).read().strip().splitlines()
+        self.assertEqual(lines[0], "# col_a;col_b")
+        self.assertEqual(lines[1], "1.1250;-2.5000")
+        got = ht.load_csv(p, sep=";", header_lines=1, split=0)
+        self.assert_array_equal(got, host)
+
+    def test_append_does_not_repeat_header(self):
+        a = np.ones((2, 2), np.float32)
+        p = self.path("app.csv")
+        ht.save_csv(ht.array(a, split=0), p, header_lines=["# h"])
+        ht.save_csv(ht.array(a * 2, split=0), p,
+                    header_lines=["# h"], truncate=False)
+        text = open(p).read()
+        self.assertEqual(text.count("# h"), 1)
+        got = ht.load_csv(p, header_lines=1, split=0)
+        self.assert_array_equal(got, np.vstack([a, a * 2]))
+
+    def test_split1_saves_row_major(self):
+        host = np.arange(24, dtype=np.float32).reshape(6, 4)
+        p = self.path("s1.csv")
+        ht.save_csv(ht.array(host, split=1), p)
+        got = ht.load_csv(p, split=0)
+        self.assert_array_equal(got, host)
