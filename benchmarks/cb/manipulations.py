@@ -55,7 +55,11 @@ def run():
     record(
         "reshape", sl.per_unit_s, per=f"{len(config.RESHAPE_SIZES)}-reshapes",
         **sl.fields(),
-        # pure data movement: each reshape reads + writes its array once
+        # pure data movement: each reshape reads + writes its array once.
+        # NB the low roofline fraction is the workload's narrow (n, 10)
+        # output: TPU tiles pad the 10-wide lane dim to 128, so the
+        # physical write traffic is ~12.8x the logical bytes counted here
+        # — a property of the reference-parity shape, not of the op
         **config.hbm_fields(
             sum(2.0 * 1000 * s * 4.0 for s in config.RESHAPE_SIZES),
             sl.per_unit_s,

@@ -16,11 +16,7 @@ import numpy as np
 
 import heat_tpu as ht
 from heat_tpu.core import io as htio
-from .test_io_deep import IOBase as IOMatrixBase
-
-
-def _splits(ndim):
-    return [None] + list(range(ndim))
+from .test_io_deep import IOBase as IOMatrixBase, _splits
 
 
 class TestHDF5SlicingOnLoad(IOMatrixBase):
