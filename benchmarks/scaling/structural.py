@@ -151,6 +151,19 @@ def main() -> None:
             "jaxpr": jaxpr_prims(ms, vals, mask),
         }
 
+        # -- distributed int-array gather (x[rows]): ONE psum_scatter of
+        # output volume, like mask-select (round 5, parallel/select.py)
+        from heat_tpu.parallel.select import _build_int_gather
+
+        n_out = n // 2
+        per_out_g = -(-n_out // D)
+        rows = jnp.zeros((per_out_g * D,), jnp.int32)
+        ig = _build_int_gather(mesh, ax, 0, 1, per_out_g)
+        leg["int_gather"] = {
+            "hlo": census_of(jax.jit(ig), vals, rows),
+            "jaxpr": jaxpr_prims(ig, vals, rows),
+        }
+
         # -- MoE dispatch: two all_to_alls of capacity slabs ---------------
         from functools import partial
 

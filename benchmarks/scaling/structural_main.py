@@ -133,6 +133,9 @@ def main() -> None:
     law("mask_select", "one_reduce_scatter_plus_count_exchange",
         counts(hlo(d0, "n1", "mask_select")),
         counts(hlo(d0, "n1", "mask_select")).get("reduce-scatter") == 1)
+    law("int_gather", "one_reduce_scatter_of_output_volume",
+        counts(hlo(d0, "n1", "int_gather")),
+        counts(hlo(d0, "n1", "int_gather")) == {"reduce-scatter": 1})
     law("moe_dispatch", "two_all_to_alls",
         counts(hlo(d0, "n1", "moe_dispatch")),
         counts(hlo(d0, "n1", "moe_dispatch")).get("all-to-all") == 2)
@@ -151,6 +154,7 @@ def main() -> None:
         "columnsort": ("all-to-all",),
         "sort_network": ("collective-permute",),
         "mask_select": ("reduce-scatter",),
+        "int_gather": ("reduce-scatter",),
         "moe_dispatch": ("all-to-all",),
         "resplit_0to1": ("all-to-all",),
         "ring_cdist": ("collective-permute",),
@@ -168,6 +172,7 @@ def main() -> None:
     strong_wls = {
         "columnsort": ("all-to-all",),
         "mask_select": ("reduce-scatter",),
+        "int_gather": ("reduce-scatter",),
         "resplit_0to1": ("all-to-all",),
         "ring_cdist": ("collective-permute",),
         "moe_dispatch": ("all-to-all",),
