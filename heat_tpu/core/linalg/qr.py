@@ -148,6 +148,53 @@ def _cholesky_qr2(arr, calc_q: bool = True, mixed: bool = False):
     return q, r
 
 
+@functools.partial(jax.jit, static_argnames=("mixed", "calc_q"))
+def _blocked_qr(arr, mixed: bool = False, calc_q: bool = True):
+    """Blocked QR for square-ish matrices (m >= n) as pure GEMMs.
+
+    XLA's Householder QR runs ~0.1-1 TFLOP/s on TPU (sequential panel
+    updates off the MXU) — the round-4/5 cb artifacts measured the square
+    n=2048 reference-CI shape at 2.4% MFU through it.  This path is BCGS2:
+    split the columns, factor the left panel (recursively, bottoming out in
+    :func:`_cholesky_qr2` once the panel is 2x-tall), then orthogonalize
+    the right block against Q1 with a classical Gram-Schmidt update
+    REPEATED ONCE (the "twice is enough" reorthogonalization — Barlow &
+    Smoktunowicz 2013 give O(eps) orthogonality for BCGS2 with a stable
+    panel factorization).  Every flop is a GEMM; the recursion unrolls at
+    trace time (depth <= log2(n)).  Ill-conditioned inputs surface as NaNs
+    through the panel Cholesky, so :func:`qr`'s eager check / Householder
+    fallback protects this path exactly as it does the tall-skinny one.
+    """
+    m, n = arr.shape
+    if m >= 2 * n:
+        return _cholesky_qr2(arr, calc_q=calc_q, mixed=mixed)
+    n1 = n // 2
+    a1, a2 = arr[:, :n1], arr[:, n1:]
+    # q1 is always needed (it orthogonalizes the right block); only the
+    # RIGHTMOST leaf's Q is skippable for R-only factorizations
+    q1, r11 = _blocked_qr(a1, mixed=mixed)
+
+    def proj(q, x):
+        # contract dim 0 directly: qᵀx without materializing qᵀ
+        return jax.lax.dot_general(
+            q, x, (((0,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST
+        )
+
+    hi = jax.lax.Precision.HIGHEST
+    t1 = proj(q1, a2)
+    a2 = a2 - jnp.matmul(q1, t1, precision=hi)
+    t2 = proj(q1, a2)  # reorthogonalize: CGS2
+    a2 = a2 - jnp.matmul(q1, t2, precision=hi)
+    r12 = t1 + t2
+    q2, r22 = _blocked_qr(a2, mixed=mixed, calc_q=calc_q)
+    q = jnp.concatenate([q1, q2], axis=1) if calc_q else None
+    r = jnp.block([
+        [r11, r12],
+        [jnp.zeros((r22.shape[0], n1), r11.dtype), r22],
+    ])
+    return q, r
+
+
 def qr(
     a: DNDarray,
     tiles_per_proc: int = 1,
@@ -161,8 +208,9 @@ def qr(
     ``tiles_per_proc`` is accepted for API parity; the TSQR tree has no tile
     knob (its panel is the device shard).
 
-    ``check`` governs the CholeskyQR2 breakdown check (single-device
-    tall-skinny path only):
+    ``check`` governs the Cholesky breakdown check on every single-device
+    GEMM path — tall-skinny CholeskyQR2 (m >= 2n) AND the square-ish
+    blocked BCGS2 path (n <= m < 2n, round 5):
 
     - ``"eager"`` (default): one host sync per call — a failed Cholesky
       (ill-conditioned input, NaNs cascade into R) is detected immediately
@@ -174,11 +222,12 @@ def qr(
       Cholesky breakdown produces NaN, not garbage values).  Use in
       pipelines that already readback downstream.
 
-    ``precision`` selects the CholeskyQR2 arithmetic: ``"float32"``
-    (default, all GEMMs f32-HIGHEST) or ``"mixed"`` (pass-1 GEMMs in bf16
-    with f32 accumulation — ~2.2x faster on v5e with f32-level
-    orthogonality; reconstruction at bf16 working precision; see
-    :func:`_cholesky_qr2`).
+    ``precision`` selects the arithmetic on the same two GEMM paths:
+    ``"float32"`` (default, all GEMMs f32-HIGHEST) or ``"mixed"``
+    (pass-1 GEMMs in bf16 with f32 accumulation — ~2.2x faster on v5e
+    with f32-level orthogonality; reconstruction at bf16 working
+    precision; see :func:`_cholesky_qr2`; the blocked path applies it
+    inside each panel).
     """
     sanitation.sanitize_in(a)
     if a.ndim != 2:
@@ -197,8 +246,16 @@ def qr(
     arr = a.larray
     if not jnp.issubdtype(arr.dtype, jnp.inexact):
         arr = arr.astype(jnp.float32)
-    if m >= 2 * n and jnp.issubdtype(arr.dtype, jnp.floating):
-        q, r = _cholesky_qr2(arr, calc_q=calc_q, mixed=(precision == "mixed"))
+    if m >= n and n >= 2 and jnp.issubdtype(arr.dtype, jnp.floating):
+        # tall: CholeskyQR2 directly; square-ish: blocked BCGS2 over
+        # CholeskyQR2 panels (round 5 — the jnp.linalg.qr fallback ran the
+        # reference-CI square shape at 2.4% MFU, ~10x below the GEMM path)
+        if m >= 2 * n:
+            q, r = _cholesky_qr2(arr, calc_q=calc_q, mixed=(precision == "mixed"))
+        else:
+            q, r = _blocked_qr(
+                arr, mixed=(precision == "mixed"), calc_q=calc_q
+            )
         # "eager": one deliberate host sync per factorization call: the
         # breakdown check (failed Cholesky cascades NaNs into R) costs one
         # scalar readback, traded against never silently returning garbage

@@ -300,3 +300,45 @@ class TestLinalgChains(TestCase):
         q, r = ht.linalg.qr(x)
         resid = ht.matmul(q, r) - x
         self.assertLess(float(ht.norm(ht.ravel(resid)).numpy()), 1e-2)
+
+
+class TestBlockedQR(TestCase):
+    """Square-ish QR (n <= m < 2n) rides the blocked BCGS2/CholeskyQR2
+    path (round 5) — correctness at reference tolerance on every split,
+    with the Householder fallback still protecting breakdowns."""
+
+    def test_shapes_splits_matrix(self):
+        rng = np.random.default_rng(55)
+        for shape in ((64, 64), (200, 150), (333, 333), (100, 99), (65, 64)):
+            host = rng.standard_normal(shape).astype(np.float32)
+            for s in (None, 0, 1):
+                with self.subTest(shape=shape, split=s):
+                    q, r = ht.linalg.qr(ht.array(host, split=s))
+                    qn, rn = q.numpy(), r.numpy()
+                    n = shape[1]
+                    self.assertLess(
+                        np.abs(qn.T @ qn - np.eye(n)).max(), 5e-4)
+                    self.assertLess(
+                        np.abs(qn @ rn - host).max() / np.abs(host).max(),
+                        5e-4)
+                    self.assertLess(np.abs(np.tril(rn, -1)).max(), 1e-6)
+                    self.assertTrue((np.diag(rn) > 0).all())
+
+    def test_defer_matches_eager(self):
+        rng = np.random.default_rng(56)
+        host = rng.standard_normal((128, 128)).astype(np.float32)
+        qe, re_ = ht.linalg.qr(ht.array(host))
+        qd, rd = ht.linalg.qr(ht.array(host), check="defer")
+        np.testing.assert_allclose(qe.numpy(), qd.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(re_.numpy(), rd.numpy(), rtol=1e-5)
+
+    def test_breakdown_falls_back(self):
+        # rank-deficient square input: panel Cholesky fails, the eager
+        # check must route to Householder and return finite factors
+        bad = np.ones((96, 96), np.float32) * 1e-20
+        bad[0, 0] = 1.0
+        q, r = ht.linalg.qr(ht.array(bad))
+        self.assertTrue(np.isfinite(q.numpy()).all())
+        self.assertTrue(np.isfinite(r.numpy()).all())
+        np.testing.assert_allclose(
+            (q.numpy() @ r.numpy()), bad, atol=1e-6)
