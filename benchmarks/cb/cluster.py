@@ -84,14 +84,13 @@ def _northstar_slope():
         "kmeans_lloyd_iter_bf16_northstar", sl.per_unit_s,
         per="lloyd-iteration", n=n, f=f, k=k, dtype="bfloat16",
         packed=True, **sl.fields(),
-        # hbm model = ONE bf16 pass over the payload (the information-
-        # theoretic floor).  The measured ~2.3 passes are the verified
-        # minimum for this architecture: the update GEMM needs each row
-        # block contracted-dim-major, and the per-block transpose
-        # (write + re-read) was probed against every alternative in
-        # round 4 (direct contraction -> 11.9 GB global relayout; block
-        # sizes 2^13..2^21 swept in round 5, 2^21 fastest)
         **config.hbm_fields(n * f * 2.0, sl.per_unit_s),
+        note="hbm model = one bf16 pass over the payload (the floor); "
+             "the measured ~2.3 passes are the verified minimum: the "
+             "update GEMM needs contracted-dim-major row blocks, and the "
+             "per-block transpose was probed against direct contraction "
+             "(11.9 GB global relayout, round 4) and block sizes "
+             "2^13..2^21 (round 5; 2^21 fastest)",
     )
 
 
