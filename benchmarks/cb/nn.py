@@ -7,6 +7,8 @@
 # chain-delta slope (config.slope) times the kernel alone — round 2's
 # single-drain pattern recorded the ~250 ms tunnel round trip as if it were
 # kernel time (attention 14.3 ms/pass recorded vs 0.94 measured).
+import functools
+
 import numpy as np
 
 import jax
@@ -18,12 +20,12 @@ from heat_tpu.utils.monitor import record
 import config
 
 
-@jax.jit
-def _attn_chain(q, n):
+@functools.partial(jax.jit, static_argnames=("causal",))
+def _attn_chain(q, n, causal=True):
     from heat_tpu.ops.attention import flash_attention
 
     return lax.fori_loop(
-        0, n, lambda i, v: flash_attention(v, v, v, causal=True), q
+        0, n, lambda i, v: flash_attention(v, v, v, causal=causal), q
     )
 
 
@@ -121,20 +123,22 @@ def run():
     bh, s_, d = config.ATTN_BH, config.ATTN_S, config.ATTN_D
     q = jnp.asarray(rng.standard_normal((bh, s_, d)), dt)
 
-    def attn_k(k):
-        config.drain(_attn_chain(q, jnp.int32(k)))
+    for causal, row in ((True, "flash_attention_forward"),
+                        (False, "flash_attention_forward_noncausal")):
+        def attn_k(k, _c=causal):
+            config.drain(_attn_chain(q, jnp.int32(k), causal=_c))
 
-    attn_k(1)  # warmup: compile once (trip count is traced)
-    sl = config.slope(attn_k)
-    record(
-        "flash_attention_forward", sl.per_unit_s, per="attention-pass",
-        causal=True, bh=bh, s=s_, d=d, **sl.fields(),
-        flop_model="4*bh*s^2*d, causal/2",
-        **config.mfu_fields(
-            config.attention_flops(bh, s_, d, causal=True), sl.per_unit_s,
-            config.PEAK_BF16_TFLOPS, "v5e bf16",
-        ),
-    )
+        attn_k(1)  # warmup: compile once (trip count is traced)
+        sl = config.slope(attn_k)
+        record(
+            row, sl.per_unit_s, per="attention-pass",
+            causal=causal, bh=bh, s=s_, d=d, **sl.fields(),
+            flop_model="4*bh*s^2*d" + (", causal/2" if causal else ""),
+            **config.mfu_fields(
+                config.attention_flops(bh, s_, d, causal=causal),
+                sl.per_unit_s, config.PEAK_BF16_TFLOPS, "v5e bf16",
+            ),
+        )
     del q
 
     t, dm, h = config.MOE_T, config.MOE_D, config.MOE_H
