@@ -1,7 +1,7 @@
 """Tutorials must run top to bottom (round 5; VERDICT r4 #8).
 
-Extracts every ```python block from docs/tutorial_30_minutes.md and
-docs/tutorial_clustering.md and executes them in order in one shared
+Extracts every ```python block from docs/tutorial_30_minutes.md,
+docs/tutorial_clustering.md, and docs/tutorial_training.md and executes them in order in one shared
 namespace per document — the markdown IS the test vector, so a doc edit
 that breaks a snippet fails CI, and a new user can paste any prefix of a
 tutorial and have it work.
@@ -36,3 +36,6 @@ class TestTutorials(TestCase):
 
     def test_tutorial_clustering(self):
         self._run_doc("tutorial_clustering.md")
+
+    def test_tutorial_training(self):
+        self._run_doc("tutorial_training.md")
