@@ -164,6 +164,9 @@ NORTHSTAR_N, NORTHSTAR_F, NORTHSTAR_K = (
 )
 RESHAPE_SIZES = [10_000, 20_000, 40_000] if ON_TPU else [1_000, 2_000]
 CONCAT_N = 1_000_000 if ON_TPU else 50_000
+# resplit_at_scale (multi-chip only): big enough that the tiled engine's
+# all_to_all loop dominates dispatch, small enough for an 8-chip CI mesh
+RESPLIT_N = 4_000_000 if ON_TPU else 100_000
 ATTN_BH, ATTN_S, ATTN_D = (16, 4096, 128) if ON_TPU else (4, 256, 32)
 MOE_T, MOE_D, MOE_H = (16_384, 1024, 4096) if ON_TPU else (512, 64, 128)
 # 5e5x1e3 f32: the fit holds x, its unit-norm copy and intermediates — ~8 GB

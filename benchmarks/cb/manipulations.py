@@ -30,6 +30,22 @@ def _reshape_chain(sizes):
     return run_k
 
 
+def _reshape_lane_chain(sizes):
+    # lane-aligned outputs: (1024, s) -> (8s, 128); the 128-wide trailing
+    # dim fills TPU tiles exactly, so logical bytes == physical bytes
+    srcs = [ht.random.random((1024, size), split=1) for size in sizes]
+
+    def run_k(k):
+        outs = []
+        for _ in range(k):
+            outs = [
+                ht.reshape(st, (st.size // 128, 128), new_split=1).larray
+                for st in srcs
+            ]
+        config.drain_all(*outs)
+    return run_k
+
+
 def _concat_chain(a, b):
     def run_k(k):
         out = None
@@ -55,13 +71,29 @@ def run():
     record(
         "reshape", sl.per_unit_s, per=f"{len(config.RESHAPE_SIZES)}-reshapes",
         **sl.fields(),
-        # pure data movement: each reshape reads + writes its array once.
-        # NB the low roofline fraction is the workload's narrow (n, 10)
-        # output: TPU tiles pad the 10-wide lane dim to 128, so the
-        # physical write traffic is ~12.8x the logical bytes counted here
-        # — a property of the reference-parity shape, not of the op
+        # pure data movement: each reshape reads + writes its array once
         **config.hbm_fields(
             sum(2.0 * 1000 * s * 4.0 for s in config.RESHAPE_SIZES),
+            sl.per_unit_s,
+        ),
+        note="the reference-parity (n, 10) output pads its 10-wide lane "
+             "dim to 128 in TPU tiles: physical write traffic is ~12.8x "
+             "the logical bytes this roofline counts, putting the "
+             "physical-traffic fraction near 0.3 — a property of the "
+             "shape, not the op; reshape_lane128 scores the op itself",
+    )
+
+    # the same op on a lane-aligned (n, 128) output — no tile padding, so
+    # the logical-byte roofline is the honest score for the engine
+    run_k = _reshape_lane_chain(config.RESHAPE_SIZES)
+    run_k(1)
+    sl = config.slope(run_k)
+    record(
+        "reshape_lane128", sl.per_unit_s,
+        per=f"{len(config.RESHAPE_SIZES)}-reshapes",
+        **sl.fields(),
+        **config.hbm_fields(
+            sum(2.0 * 1024 * s * 4.0 for s in config.RESHAPE_SIZES),
             sl.per_unit_s,
         ),
     )
@@ -97,6 +129,25 @@ def run():
              "applies; the multi-chip wire structure is asserted in "
              "SCALING_r05 (resplit_0to1: one all-to-all of the local slab)",
     )
+
+    # at-scale variant: on a real mesh resplit moves the whole slab through
+    # the tiled transport engine (parallel/transport.py) — one bounded
+    # all_to_all per column tile, wire volume exactly one slab per device
+    S = a.comm.size
+    if S > 1:
+        big = ht.random.random((config.RESPLIT_N, 128), split=0)
+        run_k = _resplit_chain(big)
+        run_k(1)
+        sl = config.slope(run_k)
+        record(
+            "resplit_at_scale", sl.per_unit_s, per="resplit",
+            mesh=S, **sl.fields(),
+            # each device reads and writes its 1/S slab once; the wire
+            # carries the same bytes (SCALING r06 tiled_resplit laws)
+            **config.hbm_fields(
+                2.0 * config.RESPLIT_N * 128 * 4.0 / S, sl.per_unit_s
+            ),
+        )
 
 
 if __name__ == "__main__":

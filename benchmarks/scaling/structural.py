@@ -11,6 +11,8 @@
 #   mask-select   1 psum_scatter of output volume      (parallel/select.py)
 #   MoE dispatch  2 all_to_all of capacity slabs       (parallel/expert.py)
 #   resplit 0->1  1 all_to_all of the local slab       (XLA resharding)
+#   tiled gather  budget-capped reduce-scatter loop    (parallel/transport.py)
+#   tiled resplit budget-capped all_to_all loop        (parallel/transport.py)
 #   ring cdist    ppermute chain inside fori_loop      (spatial/distance.py)
 #
 # This leg script lowers each program's ACTUAL compiled HLO on a forced
@@ -164,6 +166,23 @@ def main() -> None:
             "jaxpr": jaxpr_prims(ig, vals, rows),
         }
 
+        # -- tiled int-gather (round 6): SAME wire volume as the monolith,
+        # but each reduce-scatter moves one bounded tile — per-instruction
+        # bytes capped by an ABSOLUTE budget, so the staging buffer stays
+        # O(tile) while n and the mesh grow (parallel/transport.py)
+        from heat_tpu.parallel import transport
+
+        g_budget = 8 << 10
+        tile_per, kg = transport.tile_plan(per_out_g, D * 4, g_budget)
+        tg = transport._build_tiled_gather(mesh, ax, 0, 1, per_out_g, tile_per, kg)
+        rows_t = jnp.zeros((D * kg * tile_per,), jnp.int32)
+        leg["tiled_gather"] = {
+            "hlo": census_of(jax.jit(tg), vals, rows_t),
+            "jaxpr": jaxpr_prims(tg, vals, rows_t),
+            "meta": {"n_tiles": kg, "tile_budget": g_budget,
+                     "mono_bytes": per_out_g * 4},
+        }
+
         # -- MoE dispatch: two all_to_alls of capacity slabs ---------------
         from functools import partial
 
@@ -200,6 +219,22 @@ def main() -> None:
             )
 
         leg["resplit_0to1"] = {"hlo": census_of(jax.jit(resplit01), xr)}
+
+        # -- tiled resplit (round 6): the same slab, moved as a loop of
+        # bounded all_to_alls over destination-column tiles; wire total is
+        # unchanged (one slab) but each instruction is budget-capped
+        pa, pb = rrows // D, -(-rc // D)
+        r_budget = 64 << 10
+        tile_cols, kr = transport.tile_plan(pb, pa * D * 4, r_budget)
+        tr = transport._build_tiled_resplit(
+            mesh, ax, 2, 0, 1, rrows, rc, tile_cols, kr
+        )
+        leg["tiled_resplit"] = {
+            "hlo": census_of(jax.jit(tr), xr),
+            "jaxpr": jaxpr_prims(tr, xr),
+            "meta": {"n_tiles": kr, "tile_budget": r_budget,
+                     "slab_bytes": pa * pb * D * 4},
+        }
 
         # -- ring cdist: stationary x blocks, y blocks ride a ppermute ring
         from heat_tpu.spatial.distance import _build_ring_cdist

@@ -136,6 +136,12 @@ def main() -> None:
     law("int_gather", "one_reduce_scatter_of_output_volume",
         counts(hlo(d0, "n1", "int_gather")),
         counts(hlo(d0, "n1", "int_gather")) == {"reduce-scatter": 1})
+    law("tiled_gather", "one_reduce_scatter_in_tile_loop",
+        counts(hlo(d0, "n1", "tiled_gather")),
+        counts(hlo(d0, "n1", "tiled_gather")) == {"reduce-scatter": 1})
+    law("tiled_resplit", "one_all_to_all_in_tile_loop",
+        counts(hlo(d0, "n1", "tiled_resplit")),
+        counts(hlo(d0, "n1", "tiled_resplit")).get("all-to-all") == 1)
     law("moe_dispatch", "two_all_to_alls",
         counts(hlo(d0, "n1", "moe_dispatch")),
         counts(hlo(d0, "n1", "moe_dispatch")).get("all-to-all") == 2)
@@ -193,6 +199,45 @@ def main() -> None:
     ]
     law("tsqr", "per_device_bytes_grow_with_mesh", tsqr_by_d,
         all(LIN[0] <= r <= LIN[1] for r in tsqr_ratios))
+
+    # tiled-transport laws (round 6, parallel/transport.py): per-instruction
+    # collective bytes capped by the ABSOLUTE tile budget while total wire
+    # (n_tiles x bytes_out) still equals the monolithic volume, at meshes
+    # 4 AND 8 and both problem sizes — the O(N/S + tile) staging claim
+    def wl_meta(d, scale, wl):
+        return legs[d]["scales"][scale]["workloads"][wl]["meta"]
+
+    tiled_wls = {"tiled_gather": "reduce-scatter", "tiled_resplit": "all-to-all"}
+    for wl, kind in tiled_wls.items():
+        mono_key = "mono_bytes" if wl == "tiled_gather" else "slab_bytes"
+        obs, ok = {}, True
+        for d in [s for s in sizes if s in (4, 8)]:
+            for scale in ("n1", "n2"):
+                m = wl_meta(d, scale, wl)
+                b = hlo(d, scale, wl)[kind]["bytes_out"]
+                wire = m["n_tiles"] * b
+                mono = m[mono_key]
+                obs[f"D{d}/{scale}"] = {
+                    "n_tiles": m["n_tiles"], "instr_bytes": b, "wire": wire,
+                    "mono": mono,
+                }
+                ok = ok and (
+                    m["n_tiles"] > 1          # the loop actually tiles
+                    and b <= m["tile_budget"]  # each instruction in budget
+                    and mono <= wire < mono + b  # wire volume preserved
+                )
+        law(wl, "instr_bytes_budget_capped_wire_preserved", obs, ok)
+        # per-device WIRE still halves as the mesh doubles (4 -> 8): the
+        # budget caps the instruction, not the physics
+        if 4 in sizes and 8 in sizes:
+            w = {
+                d: wl_meta(d, "n1", wl)["n_tiles"]
+                * hlo(d, "n1", wl)[kind]["bytes_out"]
+                for d in (4, 8)
+            }
+            r = w[8] / w[4] if w[4] else None
+            law(wl, "per_device_wire_strong", w,
+                r is not None and HALF[0] <= r <= HALF[1])
 
     # matmul: counts AND bytes mesh-invariant (GSPMD re-chooses nothing)
     for wl in [w for w in wl_names if w.startswith("matmul_s")]:

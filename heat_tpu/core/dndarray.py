@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from . import devices, types
 from .devices import Device
+from ..parallel import transport
 from ..parallel.mesh import MeshComm, sanitize_comm
 from .stride_tricks import sanitize_axis
 
@@ -375,6 +376,11 @@ class DNDarray:
             self.__dtype = types.canonical_heat_type(casted.dtype)
             self._invalidate_halos()
             return self
+        if casted is self.__array:
+            # same-dtype astype aliases in jax; honor copy=True so a later
+            # in-place resplit_ (which DONATES its buffer) can't invalidate
+            # the returned array
+            casted = jnp.copy(casted)
         return DNDarray(
             casted,
             self.__gshape,
@@ -439,12 +445,27 @@ class DNDarray:
 
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place re-partition to a new split axis (reference:
-        dndarray.py:1367-1496). One ``device_put`` — XLA emits the
-        all-gather / all-to-all over ICI."""
+        dndarray.py:1367-1496).
+
+        Axis-to-axis moves route through the tiled transport engine
+        (:mod:`heat_tpu.parallel.transport`): a loop of bounded
+        ``all_to_all`` tiles on the PHYSICAL array — no unpad/re-pad round
+        trip — with the old buffer DONATED to XLA so both layouts are
+        never live together.  Donation makes this genuinely destructive:
+        any alias of the old physical buffer (e.g. a ``.larray`` reference
+        taken before the call) is invalidated.  Moves to/from
+        ``split=None`` keep the ``device_put`` route (an all-gather /
+        initial scatter, nothing to tile)."""
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = _to_physical(self.larray, self.__gshape, axis, self.__comm)
+        if transport.resplit_applicable(self.__gshape, self.__split, axis, self.__comm):
+            self.__array = transport.tiled_resplit(
+                self.__array, self.__gshape, self.__split, axis, self.__comm,
+                donate=True,
+            )
+        else:
+            self.__array = _to_physical(self.larray, self.__gshape, axis, self.__comm)
         self.__split = axis
         self.__lshape_map = None
         self._invalidate_halos()
@@ -453,7 +474,10 @@ class DNDarray:
     def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
         """Reference API (dndarray.py:1161-1318) allowed arbitrary target
         lshape maps. GSPMD owns physical layout; only the canonical layout is
-        representable, so this is a no-op (with a check)."""
+        representable, so this is a no-op (with a check).  Layout changes
+        that ARE representable — a new split axis — move data through the
+        tiled transport engine via :meth:`resplit_`
+        (:mod:`heat_tpu.parallel.transport`)."""
         if target_map is not None:
             target = np.asarray(target_map)
             if not np.array_equal(target, self.lshape_map):
@@ -1001,21 +1025,30 @@ class DNDarray:
     def __int_take_route(self, key) -> Optional["DNDarray"]:
         """Distributed integer-array gather (round 5; VERDICT r4 weak #3).
 
-        Routes the ``x[rows]`` / ``x[rows, cols]`` class — a host-known 1-D
-        int array on the split dim, optionally paired with ONE other
-        host-known int array or scalar int key, every other position a full
-        slice — through :func:`parallel.select.distributed_take`: each
-        shard contributes the requested rows it owns and one
-        ``psum_scatter`` of the OUTPUT volume delivers every output shard;
-        the input is never gathered and no input-sized buffer exists in the
-        compiled program (asserted by tests/test_census_structural.py).
-        Device-resident or broadcast-shaped keys return ``None`` → the
-        documented replicated fallback.
+        Routes the ``x[rows]`` / ``x[rows, cols]`` class — a 1-D int array
+        on the split dim, optionally paired with ONE other host-known int
+        array or scalar int key, every other position a full slice —
+        through :func:`parallel.select.distributed_take` (the tiled
+        transport engine since round 6): per output tile, each shard
+        contributes the requested rows it owns and one ``psum_scatter``
+        delivers the tile; the input is never gathered and no input-sized
+        buffer exists in the compiled program (asserted by
+        tests/test_census_structural.py).  ``rows`` may be host-known
+        (``np.ndarray`` — out-of-bounds raises) or device-resident (a jax
+        array or int ``DNDarray``, e.g. a ``nonzero()`` product — out-of-
+        bounds clamps, matching jax's device-key semantics; the output
+        extent ``rows.shape[0]`` is static, so no host sync).
+        Broadcast-shaped keys return ``None`` → the documented replicated
+        fallback.
         """
         if self.__split is None or not self.is_distributed():
             return None
         keys = key if isinstance(key, tuple) else (key,)
-        keys = tuple(np.asarray(k) if isinstance(k, list) else k for k in keys)
+        keys = tuple(
+            np.asarray(k) if isinstance(k, list)
+            else (k.larray if isinstance(k, DNDarray) else k)
+            for k in keys
+        )
         if sum(1 for k in keys if k is Ellipsis) > 1:
             return None
         n_spec = sum(1 for k in keys if k is not Ellipsis)
@@ -1036,6 +1069,13 @@ class DNDarray:
                 and np.issubdtype(k.dtype, np.integer)
             )
 
+        def is_dev_int_arr(k):
+            return (
+                isinstance(k, jax.Array)
+                and k.ndim == 1
+                and jnp.issubdtype(k.dtype, jnp.integer)
+            )
+
         rows = None
         pair = None  # (position, cols-array-or-int)
         for p, k in enumerate(expanded):
@@ -1043,7 +1083,7 @@ class DNDarray:
                 if k != slice(None):
                     return None
                 continue
-            if p == self.__split and is_host_int_arr(k):
+            if p == self.__split and (is_host_int_arr(k) or is_dev_int_arr(k)):
                 rows = k
             elif p != self.__split and pair is None and (
                 is_host_int_arr(k)
@@ -1070,7 +1110,16 @@ class DNDarray:
         split = self.__split
         comm = self.__comm
         n_axis = self.__gshape[split]
-        rows_n = norm(rows, n_axis, "index array")
+        if isinstance(rows, jax.Array):
+            # device-resident: normalize without a host sync — negatives
+            # shifted, then clamped to the logical extent (jax device-key
+            # semantics; host keys above raise instead)
+            rows_n = jnp.clip(
+                jnp.where(rows < 0, rows + n_axis, rows).astype(jnp.int32),
+                0, max(n_axis - 1, 0),
+            )
+        else:
+            rows_n = norm(rows, n_axis, "index array")
         L = int(rows_n.shape[0])
         if L == 0:
             return None  # empty selection: generic path handles shape/meta
@@ -1161,7 +1210,11 @@ class DNDarray:
             elif isinstance(k, (jnp.ndarray, jax.Array)) and jnp.issubdtype(
                 k.dtype, jnp.integer
             ):
-                out.append(jnp.where(k < 0, k + n, k))
+                # clamp WITHIN the logical extent: jax's scatter/gather clamp
+                # out-of-bounds device keys to the PHYSICAL edge, which on the
+                # split dim is padding — a silent write into (or read of) pad
+                # cells that logical indexing must never touch
+                out.append(jnp.clip(jnp.where(k < 0, k + n, k), 0, max(n - 1, 0)))
             else:
                 return None
             dim += 1

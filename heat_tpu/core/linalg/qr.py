@@ -29,9 +29,34 @@ from .. import sanitation, types
 from ..dndarray import DNDarray, _ensure_split
 from ...parallel.collectives import shard_map_unchecked as _shard_map
 
-__all__ = ["qr"]
+__all__ = ["qr", "orthogonality_defect"]
 
 QR = collections.namedtuple("QR", "Q, R")
+
+
+def orthogonality_defect(q: DNDarray) -> DNDarray:
+    """Post-hoc orthogonality probe: ``max|QᵀQ - I|`` as a 0-d DNDarray.
+
+    The opt-in companion to ``qr(..., check="defer")``: the deferred path
+    NaN-latches Cholesky *breakdown* but cannot flag the conditioning band
+    (cond(A) ≳ 1/sqrt(eps_f32) ≈ 3e3, see :func:`qr`) where the GEMM paths
+    return finite factors of degraded orthogonality.  This is one GEMM over
+    the split axis (split-0 inputs: XLA lowers the contraction to a single
+    all-reduce of the n×n Gram matrix) and stays on device — dispatch
+    remains async until the caller reads the scalar back.  Well-conditioned
+    f32 factors probe at ~1e-6; values ≫ sqrt(eps_f32) ≈ 3e-4 mean the
+    factorization should be re-run with Householder (the replicated
+    ``jnp.linalg.qr`` route) or in f64."""
+    sanitation.sanitize_in(q)
+    arr = q.larray
+    gram = jnp.matmul(
+        arr.T, arr, precision=jax.lax.Precision.HIGHEST
+    )
+    defect = jnp.max(jnp.abs(gram - jnp.eye(gram.shape[0], dtype=gram.dtype)))
+    return DNDarray(
+        defect, (), types.canonical_heat_type(defect.dtype),
+        None, q.device, q.comm,
+    )
 
 
 def _build_tsqr(mesh, axis, calc_q: bool = True):
@@ -221,6 +246,20 @@ def qr(
       the caller's next readback (never silently-wrong finite numbers —
       Cholesky breakdown produces NaN, not garbage values).  Use in
       pipelines that already readback downstream.
+
+      **Conditioning bound**: the NaN latch only fires when Cholesky
+      *breaks down*.  CholeskyQR2 (and the blocked BCGS2 path built on
+      it, n <= m < 2n) squares the condition number in the Gram matrix,
+      so the first pass stays finite while ``cond(A)^2 * eps_f32 < 1`` —
+      i.e. up to ``cond(A) ≈ 1/sqrt(eps_f32) ≈ 3e3`` in f32.  Inputs in
+      the band between ~3e3 and breakdown (~1/eps ≈ 1e7) return FINITE
+      factors whose orthogonality error ``||QᵀQ - I||`` degrades
+      gradually; ``"defer"`` cannot flag those.  When the input's
+      conditioning is unknown, either use ``"eager"`` (breakdown still
+      NaN-latches; moderate ill-conditioning is inherent to the GEMM
+      path either way) or probe the result post-hoc with
+      :func:`orthogonality_defect` — one GEMM, no sync until *its*
+      readback.
 
     ``precision`` selects the arithmetic on the same two GEMM paths:
     ``"float32"`` (default, all GEMMs f32-HIGHEST) or ``"mixed"``

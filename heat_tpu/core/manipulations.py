@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from . import factories, sanitation, stride_tricks, types
 from .dndarray import DNDarray, _ensure_split, _to_physical
+from ..parallel import transport
 
 __all__ = [
     "balance",
@@ -110,6 +111,7 @@ def broadcast_to(x: DNDarray, shape) -> DNDarray:
 
 def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Stack 1-D/2-D arrays as columns (reference: manipulations.py)."""
+    arrays = list(arrays)  # generators survive the _require_dndarray pass
     ref = _require_dndarray(arrays, "column_stack")
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
     result = jnp.column_stack(prepared)
@@ -209,6 +211,7 @@ def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
 
 def hstack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Horizontal stack."""
+    arrays = list(arrays)  # generators survive the _require_dndarray pass
     ref = _require_dndarray(arrays, "hstack")
     axis = 0 if ref.ndim == 1 else 1
     return concatenate(arrays, axis=axis)
@@ -218,6 +221,7 @@ def dstack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Depth-wise stack along the third axis (numpy parity; the reference
     ships vstack/hstack/row_stack only — dstack completes the family the
     same way dsplit already does)."""
+    arrays = list(arrays)  # generators survive the _require_dndarray pass
     ref = _require_dndarray(arrays, "dstack")
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
     result = jnp.dstack(prepared)
@@ -282,9 +286,17 @@ def repeat(a: DNDarray, repeats, axis=None) -> DNDarray:
 
 def reshape(a: DNDarray, *shape, new_split=None) -> DNDarray:
     """Reshape (reference: manipulations.py:1821 — resplit-to-0 + Alltoallv
-    there; one jnp.reshape with a target sharding here).  ``new_split`` sets
-    the split of the result (defaults to the input's split when the dim count
-    allows, else 0 for distributed inputs)."""
+    there).  ``new_split`` sets the split of the result (defaults to the
+    input's split when the dim count allows, else 0 for distributed inputs).
+
+    Distributed→distributed reshapes route through the tiled transport
+    engine (:mod:`heat_tpu.parallel.transport`): split-preserving shapes
+    reshape each shard locally (collective-free); split-crossing shapes run
+    resplit-to-0 → flat rechunk (one ``ppermute`` per host-known chunk-
+    boundary shift) → resplit-to-target, all on physical arrays with the
+    stage intermediates donated.  Shapes outside the engine's plan budget —
+    and replicated inputs or outputs — keep the global-``jnp.reshape``
+    route, where XLA emits the collectives."""
     sanitation.sanitize_in(a)
     if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
         shape = tuple(shape[0])
@@ -300,25 +312,54 @@ def reshape(a: DNDarray, *shape, new_split=None) -> DNDarray:
         raise ValueError(
             f"cannot reshape array of size {a.size} into shape {tuple(shape)}"
         )
-    result = jnp.reshape(a.larray, shape)
+    gout = tuple(a.size // prod if d == -1 else int(d) for d in shape)
     if new_split is None:
         if a.split is None:
             new_split = None
-        elif a.split < result.ndim:
+        elif a.split < len(gout):
             new_split = a.split
         else:
             new_split = 0
+    if (
+        a.split is not None
+        and new_split is not None
+        and a.comm.size > 1
+        and len(gout) >= 1
+    ):
+        try:
+            ns = stride_tricks.sanitize_axis(gout, new_split)
+        except (ValueError, TypeError):
+            ns = None
+        if ns is not None and transport.reshape_applicable(
+            a.shape, a.split, gout, ns, a.comm
+        ):
+            phys = transport.tiled_reshape(
+                a.parray, a.shape, a.split, gout, ns, a.comm
+            )
+            return DNDarray(
+                phys, gout, a.dtype, ns, a.device, a.comm
+            )
+    result = jnp.reshape(a.larray, shape)
     return _wrap(result, a, new_split)
 
 
 def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     """Out-of-place re-partition (reference: manipulations.py:3325 — axis=None
-    is an Allgatherv there; a device_put here either way)."""
+    is an Allgatherv there).  Axis-to-axis moves run through the tiled
+    transport engine on the physical array (bounded ``all_to_all`` tiles, no
+    unpad/re-pad round trip; the input buffer is NOT donated — the caller
+    keeps its array); moves to/from ``split=None`` keep the ``device_put``
+    route."""
     sanitation.sanitize_in(arr)
     axis = stride_tricks.sanitize_axis(arr.shape, axis)
     if axis == arr.split:
         return arr
-    physical = _to_physical(arr.larray, arr.shape, axis, arr.comm)
+    if transport.resplit_applicable(arr.shape, arr.split, axis, arr.comm):
+        physical = transport.tiled_resplit(
+            arr.parray, arr.shape, arr.split, axis, arr.comm, donate=False
+        )
+    else:
+        physical = _to_physical(arr.larray, arr.shape, axis, arr.comm)
     return DNDarray(physical, arr.shape, arr.dtype, axis, arr.device, arr.comm)
 
 
@@ -471,6 +512,7 @@ def squeeze(x: DNDarray, axis=None) -> DNDarray:
 
 def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
     """Join along a new axis (reference: manipulations.py stack)."""
+    arrays = list(arrays)  # generators survive the _require_dndarray pass
     ref = _require_dndarray(arrays, "stack")
     prepared = [a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
     result = jnp.stack(prepared, axis=axis)
@@ -671,6 +713,7 @@ def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
 
 
 def vstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    arrays = list(arrays)  # generators survive the _require_dndarray pass
     ref = _require_dndarray(arrays, "vstack")
     prepared = []
     for a in arrays:

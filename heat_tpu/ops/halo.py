@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.collectives import shard_map
+from ..parallel.collectives import axis_size, shard_map_unchecked
 
 __all__ = ["exchange_halos", "halo_exchange", "map_with_halos"]
 
@@ -45,7 +45,7 @@ def halo_exchange(
     ``wrap=True``, and callers mask edges exactly like the reference's
     populated-rank logic).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
 
     first = lax.slice_in_dim(local, 0, halo_size, axis=axis)
@@ -103,7 +103,7 @@ def map_with_halos(
     spec = comm.spec(split, x.ndim)
 
     def shard_fn(local):
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         moved = jnp.moveaxis(local, split, 0) if split != 0 else local
         prev_h, next_h = halo_exchange(moved, halo_size, axis_name, axis=0, wrap=wrap)
@@ -117,8 +117,8 @@ def map_with_halos(
     # beyond the logical end behave as zero halos, which matches the zero
     # boundary condition stencils expect; fn must preserve the shard shape
     # along the split axis.
-    out = shard_map(
-        shard_fn, mesh=comm.mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    out = shard_map_unchecked(
+        shard_fn, comm.mesh, in_specs=(spec,), out_specs=spec
     )(x.parray)
     return DNDarray(out, x.gshape, types.heat_type_of(out), split, x.device, x.comm)
 
@@ -129,9 +129,9 @@ def _build_exchange(mesh, axis_name, spec, split, halo_size):
         prev_h, next_h = halo_exchange(moved, halo_size, axis_name, axis=0)
         return prev_h, next_h
 
-    return shard_map(
-        shard_fn, mesh=mesh, in_specs=(spec,),
-        out_specs=(P(axis_name), P(axis_name)), check_vma=False,
+    return shard_map_unchecked(
+        shard_fn, mesh, in_specs=(spec,),
+        out_specs=(P(axis_name), P(axis_name)),
     )
 
 

@@ -88,7 +88,12 @@ def axis_index(axis: str):
 
 def axis_size(axis: str) -> int:
     """Number of shards along the mesh axis (reference: comm.size)."""
-    return lax.axis_size(axis)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    # jax < 0.5 has no lax.axis_size; axis_frame returns the size (int on
+    # 0.4.x, a frame with .size on some releases)
+    frame = jax.core.axis_frame(axis)
+    return frame if isinstance(frame, int) else frame.size
 
 
 def psum(x, axis: str):
@@ -132,7 +137,7 @@ def ring_shift(x, axis: str, *, shift: int = 1):
     exchanges in dndarray.py:1161-1318): a ``collective_permute`` rides the ICI
     torus links directly.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -150,7 +155,7 @@ def exscan(x, axis: str, *, op: Callable = jnp.add, neutral=0):
     """Exclusive prefix scan over the mesh axis (reference: Exscan,
     communication.py:925-1025). Gathers the per-shard values (small — one
     scalar/slab per shard) and combines prefixes locally."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     gathered = lax.all_gather(x, axis_name=axis, axis=0, tiled=False)  # (n, ...)
     mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * (gathered.ndim - 1))

@@ -129,73 +129,56 @@ def distributed_mask_select(
     return fn(phys_vals, phys_mask)
 
 
-def _build_int_gather(mesh, axis_name, split, ndim, per_out):
+def _build_int_gather(mesh, axis_name, split, ndim, per_out,
+                      tile_per=None, n_tiles=1):
     """Distributed integer-array gather along the split axis (round 5;
     VERDICT r4 weak #3 / next #5): output row ``t`` is input row
-    ``rows[t]``.  Each shard contributes the requested rows it owns into a
-    destination-ordered buffer and ONE ``psum_scatter`` (reduce-scatter)
-    delivers every output shard — wire volume is the OUTPUT size; the
-    input is never gathered (the reference keeps these distributed too,
-    dndarray.py:779-1035).  ``rows`` rides replicated: it is host-known
-    index metadata (n_out ints), not data."""
+    ``rows[t]``.  Since round 6 this is the tiled transport engine
+    (:mod:`heat_tpu.parallel.transport`): per output tile, each shard
+    contributes the requested rows it owns and ONE ``psum_scatter``
+    (reduce-scatter) delivers the tile — wire volume is the OUTPUT size,
+    staging is ``S*tile`` rows (never the global output the round-5
+    monolith staged), and the input is never gathered (the reference
+    keeps these distributed too, dndarray.py:779-1035).  ``rows`` rides
+    replicated in destination-grid layout: it is index metadata (n_out
+    ints), not data.  ``tile_per=None`` means one tile of ``per_out``
+    rows (the monolithic special case)."""
+    from .transport import _build_tiled_gather
 
-    def local(vals, rows):
-        r = lax.axis_index(axis_name)
-        v = jnp.moveaxis(vals, split, 0)
-        per_in = v.shape[0]
-        loc = rows - r * per_in                      # (S*per_out,) int32
-        mine = (loc >= 0) & (loc < per_in)
-        safe = jnp.clip(loc, 0, max(per_in - 1, 0))
-        picked = jnp.take(v, safe, axis=0)
-        mine_b = mine.reshape((-1,) + (1,) * (picked.ndim - 1))
-        picked = jnp.where(mine_b, picked, jnp.zeros((), picked.dtype))
-        out = lax.psum_scatter(picked, axis_name, scatter_dimension=0, tiled=True)
-        return jnp.moveaxis(out, 0, split)
-
-    dim_spec = P(*[axis_name if d == split else None for d in range(ndim)])
-    smapped = shard_map_unchecked(
-        local, mesh, in_specs=(dim_spec, P()), out_specs=dim_spec
+    if tile_per is None:
+        tile_per = per_out
+    return _build_tiled_gather(
+        mesh, axis_name, split, ndim, per_out, tile_per, n_tiles
     )
-
-    def run(vals, rows):
-        isbool = vals.dtype == jnp.bool_
-        v = vals.astype(jnp.uint8) if isbool else vals
-        out = smapped(v, rows)
-        return out.astype(jnp.bool_) if isbool else out
-
-    return run
 
 
 @lru_cache(maxsize=512)
-def _jit_int_gather(mesh, axis_name, split, ndim, per_out):
-    return jax.jit(_build_int_gather(mesh, axis_name, split, ndim, per_out))
+def _jit_int_gather(mesh, axis_name, split, ndim, per_out,
+                    tile_per=None, n_tiles=1):
+    return jax.jit(
+        _build_int_gather(mesh, axis_name, split, ndim, per_out, tile_per, n_tiles)
+    )
 
 
 def distributed_take(
     phys_vals: jax.Array,
-    rows: np.ndarray,
+    rows,
     mesh,
     axis_name: str,
     split: int,
 ):
     """Gather ``phys_vals``'s rows ``rows`` along the sharded axis
-    ``split`` (canonical physical layout).  ``rows`` must be host-known,
-    1-D, already normalized to the valid non-negative range by the caller
+    ``split`` (canonical physical layout).  ``rows`` is 1-D int — host-
+    (``np.ndarray``) or device-resident (``jax.Array``) — already
+    normalized to the valid non-negative range by the caller
     (out-of-range rows would silently read padding).  Returns the physical
     output: canonical even-chunk layout with extent ``len(rows)`` on the
-    split axis.  No device sync: the output extent is host-known."""
-    S = int(mesh.shape[axis_name])
-    n_out = int(rows.shape[0])
-    per_out = -(-n_out // S) if n_out else 1
-    pad = S * per_out - n_out
-    # padded destinations source row 0 (any valid row): the pad region of
-    # the canonical output layout carries no logical cells
-    rows_pad = np.concatenate([
-        np.asarray(rows, np.int32),
-        np.zeros((pad,), np.int32),
-    ])
-    fn = _jit_int_gather(mesh, axis_name, int(split), phys_vals.ndim, per_out)
-    return fn(phys_vals, jnp.asarray(rows_pad))
+    split axis.  No device sync: the output extent is ``rows.shape[0]``,
+    static either way.  Routed through the tiled transport engine: peak
+    staging per device is ``O(tile)``, not the global output."""
+    from .transport import tiled_take
+
+    return tiled_take(phys_vals, rows, mesh, axis_name, split)
 
 
 def _build_pair_take(mesh, axis_name, t_ax, p2, ndim):

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .collectives import shard_map
+from .collectives import axis_size, shard_map_unchecked
 
 __all__ = [
     "ring_attention",
@@ -87,7 +87,7 @@ def ring_attention(
     Ring Attention schedule (Liu et al., 2023), built from the same ring
     dataflow as the reference's pairwise-distance loop
     (heat/spatial/distance.py:209)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     sq = q.shape[-2]
     sk = k.shape[-2]
@@ -143,7 +143,7 @@ def ulysses_attention(
     restores sequence sharding."""
     from ..ops.attention import flash_attention
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = q.shape[0]
     if h % n:
         raise ValueError(f"heads {h} not divisible by mesh axis size {n}")
@@ -202,10 +202,9 @@ def sequence_parallel_attention(
             )
             return out.reshape(b, h, s, d)
 
-    return shard_map(
+    return shard_map_unchecked(
         fn,
-        mesh=mesh,
+        mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )(q, k, v)

@@ -65,7 +65,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .collectives import shard_map_unchecked
+from .collectives import axis_size, shard_map_unchecked
 
 __all__ = ["distributed_sort", "distributed_topk", "unique_compact_sorted"]
 
@@ -454,7 +454,7 @@ def _build_unique_compact(mesh, axis_name, n_valid, per):
 
     def local(vals):
         r = lax.axis_index(axis_name)
-        nshards = lax.axis_size(axis_name)
+        nshards = axis_size(axis_name)
         pos = r * per + jnp.arange(per)
         validm = pos < n_valid
         ring = [(i, (i + 1) % nshards) for i in range(nshards)]

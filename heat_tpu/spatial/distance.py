@@ -76,15 +76,14 @@ def _sq_euclidean(xa, ya):
 
 def _build_rowsplit(mesh, spec, sqrt: bool):
     from ..ops.cdist import cdist as _fused
-    from ..parallel.collectives import shard_map
+    from ..parallel.collectives import shard_map_unchecked
     from jax.sharding import PartitionSpec as P
 
-    return shard_map(
+    return shard_map_unchecked(
         lambda xs, ys: _fused(xs, ys, sqrt=sqrt),
-        mesh=mesh,
+        mesh,
         in_specs=(spec, P()),
         out_specs=spec,
-        check_vma=False,
     )
 
 
@@ -124,7 +123,7 @@ def _build_ring_cdist(mesh, axis, n_dev, sqrt):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.collectives import ring_shift, shard_map
+    from ..parallel.collectives import ring_shift, shard_map_unchecked
 
     def shard_fn(xs, ys):
         me = lax.axis_index(axis)
@@ -145,9 +144,9 @@ def _build_ring_cdist(mesh, axis, n_dev, sqrt):
         _, out = lax.fori_loop(0, n_dev, body, (ys, out))
         return jnp.sqrt(out) if sqrt else out
 
-    return shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(axis, None), P(axis, None)),
-        out_specs=P(axis, None), check_vma=False,
+    return shard_map_unchecked(
+        shard_fn, mesh, in_specs=(P(axis, None), P(axis, None)),
+        out_specs=P(axis, None),
     )
 
 

@@ -14,6 +14,8 @@
 #   5. cbsmoke  — one fast cb workload end-to-end (CPU sizes) proving the
 #                 benchmark harness runs
 #   6. copycheck— scripts/copycheck.py (difflib vs reference, 0.6 bar)
+#   7. notes    — every committed cb row under 30% of its roofline must
+#                 carry a note naming the bound (no silent bad scores)
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -26,7 +28,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/6 suite (8-device mesh)"
+say "1/7 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -35,21 +37,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/6 core subset (4-device mesh)"
+say "2/7 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/6 parity audit (exits nonzero on any gap)"
+say "3/7 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/6 multi-chip dry-run"
+say "4/7 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/6 cb smoke"
+say "5/7 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -58,7 +60,23 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/6 copycheck"
+say "6/7 copycheck"
 python scripts/copycheck.py
+
+say "7/7 roofline notes (every low-roofline cb row carries its bound story)"
+python - <<'EOF'
+import glob, json, sys
+bad = []
+for path in sorted(glob.glob("BENCH_cb_*.json")):
+    doc = json.load(open(path))
+    for row in doc.get("measurements", []):
+        frac = row.get("hbm_roofline_frac")
+        if frac is not None and frac < 0.3 and not row.get("note"):
+            bad.append(f"{path}: {row['name']} at {frac} lacks a note")
+if bad:
+    print("\n".join(bad))
+    sys.exit(1)
+print("all low-roofline rows annotated")
+EOF
 
 say "CI GREEN"

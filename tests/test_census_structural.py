@@ -201,3 +201,147 @@ class TestIntGatherCensus(TestCase):
         fn = _jit_pair_take(comm.mesh, comm.split_axis, 0, 1, 2)
         c = hlo_census(fn.lower(phys, cols).compile().as_text())
         self.assertEqual(c, {})  # purely local pairing
+
+
+def _max_f32_elems(text):
+    import re
+
+    return max(
+        int(np.prod([int(d) for d in m[4:-1].split(",")]))
+        for m in set(re.findall(r"f32\[[\d,]+\]", text))
+    )
+
+
+class TestTiledTransportCensus(TestCase):
+    """Round-6 tentpole laws (ISSUE 1): per-device peak buffer for the
+    tiled gather / resplit of global size N on S shards is O(N/S + tile),
+    never O(N); collectives count once (loops counted once) at tile-sized
+    per-instruction bytes; total wire = n_tiles x tile = the round-5
+    routes' volume within one tile of rounding.  Asserted at the suite's
+    8-device mesh AND a 4-device submesh (compile-only census — the law
+    must hold at every mesh size, not just the one the suite runs)."""
+
+    N, F = 4096, 32          # gather workload: (N, F) f32, split 0
+    RESPLIT = (512, 512)     # resplit workload: f32, split 0 -> 1
+
+    def _gather_laws(self, comm):
+        from heat_tpu.parallel.transport import _jit_tiled_gather, tile_plan
+
+        S = comm.size
+        n, f = self.N, self.F
+        phys = jax.device_put(
+            jnp.zeros((n, f), jnp.float32), comm.sharding(0, 2)
+        )
+        n_out = 1000
+        per_out = -(-n_out // S)
+        # force real tiling: ~16 output rows per tile
+        tile_bytes = 16 * S * f * 4
+        tile_per, n_tiles = tile_plan(per_out, S * f * 4, tile_bytes)
+        self.assertGreater(n_tiles, 1, "law must exercise the tile loop")
+        rows = jnp.zeros((S * n_tiles * tile_per,), jnp.int32)
+        fn = _jit_tiled_gather(
+            comm.mesh, comm.split_axis, 0, 2, per_out, tile_per, n_tiles
+        )
+        text = fn.lower(phys, rows).compile().as_text()
+        c = hlo_census(text)
+        # one reduce-scatter (the fori_loop body counts once), tile-sized
+        self.assertEqual(c["reduce-scatter"]["count"], 1)
+        self.assertEqual(c["reduce-scatter"]["bytes_out"], tile_per * f * 4)
+        self.assertNotIn("all-gather", c)
+        # wire unchanged vs the r05 monolith: n_tiles tiles cover the
+        # output volume within one tile of rounding
+        wire = n_tiles * c["reduce-scatter"]["bytes_out"]
+        self.assertGreaterEqual(wire, per_out * f * 4)
+        self.assertLess(wire, (per_out + tile_per) * f * 4)
+        # peak law: O(N/S + tile) — the local slab dominates; never O(N)
+        slab = n * f // S
+        staging = S * tile_per * f
+        self.assertLessEqual(_max_f32_elems(text), slab + staging)
+
+    def _resplit_laws(self, comm):
+        from heat_tpu.parallel.transport import _jit_tiled_resplit, tile_plan
+
+        S = comm.size
+        n_a, n_b = self.RESPLIT
+        phys = jax.device_put(
+            jnp.zeros((n_a, n_b), jnp.float32), comm.sharding(0, 2)
+        )
+        pa, pb = n_a // S, -(-n_b // S)
+        # force real tiling: ~8 destination columns per tile
+        tile_cols, n_tiles = tile_plan(pb, pa * S * 4, 8 * pa * S * 4)
+        self.assertGreater(n_tiles, 1, "law must exercise the tile loop")
+        fn = _jit_tiled_resplit(
+            comm.mesh, comm.split_axis, 2, 0, 1, n_a, n_b,
+            tile_cols, n_tiles, False,
+        )
+        text = fn.lower(phys).compile().as_text()
+        c = hlo_census(text)
+        self.assertEqual(c["all-to-all"]["count"], 1)
+        self.assertEqual(c["all-to-all"]["bytes_out"], S * pa * tile_cols * 4)
+        self.assertNotIn("all-gather", c)
+        # wire unchanged vs the r05 GSPMD route (= one local slab/device,
+        # test_resplit_one_all_to_all) within one tile of rounding
+        slab_bytes = n_a * n_b * 4 // S
+        wire = n_tiles * c["all-to-all"]["bytes_out"]
+        self.assertGreaterEqual(wire, slab_bytes)
+        self.assertLess(wire, slab_bytes + S * pa * tile_cols * 4)
+        # peak law: O(N/S + tile) — slab-proportional, never O(N)
+        slab = n_a * n_b // S
+        tile = S * pa * tile_cols
+        self.assertLessEqual(_max_f32_elems(text), 2 * slab + tile)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_tiled_gather_mesh8(self):
+        self._gather_laws(self.comm)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_tiled_resplit_mesh8(self):
+        self._resplit_laws(self.comm)
+
+    @unittest.skipIf(len(jax.devices()) < 4, "needs at least 4 devices")
+    def test_tiled_gather_mesh4(self):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        self._gather_laws(local_mesh(4))
+
+    @unittest.skipIf(len(jax.devices()) < 4, "needs at least 4 devices")
+    def test_tiled_resplit_mesh4(self):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        self._resplit_laws(local_mesh(4))
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_device_resident_key_routes_tiled(self):
+        """The x[ht.array(rows)] class (VERDICT r5 weak #2): a device-
+        resident (e.g. nonzero()-produced) index key compiles to the same
+        tiled gather — one reduce-scatter, no all-gather, no input-sized
+        buffer — with the grid construction fused in (no host sync)."""
+        from heat_tpu.parallel.transport import tiled_take
+
+        comm = self.comm
+        n, f = self.N, self.F
+        phys = jax.device_put(
+            jnp.zeros((n, f), jnp.float32), comm.sharding(0, 2)
+        )
+        rows = jnp.zeros((1000,), jnp.int32)  # device-resident key
+
+        def take(v, r):
+            return tiled_take(v, r, comm.mesh, comm.split_axis, 0)
+
+        fn = jax.jit(take)
+        text = fn.lower(phys, rows).compile().as_text()
+        c = hlo_census(text)
+        self.assertEqual(c["reduce-scatter"]["count"], 1)
+        self.assertNotIn("all-gather", c)
+        self.assertLess(_max_f32_elems(text), n * f // 2)
+        # and the DNDarray route produces the right VALUES end to end
+        import heat_tpu as ht
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((96, 4)).astype(np.float32)
+        a = ht.array(x, split=0)
+        mask = ht.array(x[:, 0] > 0)
+        idx = ht.nonzero(mask)
+        got = a[idx]
+        want = x[np.asarray(x[:, 0] > 0).nonzero()[0]]
+        self.assertTrue(np.array_equal(got.numpy(), want))

@@ -11,6 +11,8 @@ via comm.chunk, the reference's own test oracle, SURVEY.md §4).
 
 import numpy as np
 
+import jax.numpy as jnp
+
 import heat_tpu as ht
 from .base import TestCase
 
@@ -460,3 +462,52 @@ class TestIntTakeRouted(TestCase):
             x[np.array([0, 20])]
         with self.assertRaises(IndexError):
             x[np.array([0, 1]), np.array([0, 9])]
+
+
+class TestDeviceResidentKeys(TestCase):
+    """Device-resident int keys (round 6): jax-array / int-DNDarray keys
+    on the split dim route through the tiled gather (no replication), and
+    out-of-bounds values clamp WITHIN the logical extent — never into
+    split-dim padding (ADVICE r5 #1)."""
+
+    def test_device_rows_match_host_rows(self):
+        host = np.arange(203, dtype=np.float32).reshape(29, 7)
+        rows = np.array([0, 28, 3, 3, -1, 17, 5], np.int32)
+        x = ht.array(host, split=0)
+        for key in (jnp.asarray(rows), ht.array(rows)):
+            got = x[key]
+            self.assertEqual(got.split, 0)
+            self.assert_array_equal(got, host[rows])
+
+    def test_nonzero_produced_key(self):
+        host = np.arange(60, dtype=np.float32).reshape(20, 3)
+        x = ht.array(host, split=0)
+        idx = ht.nonzero(ht.array(host[:, 0] % 2 == 0))
+        got = x[idx]
+        want = host[host[:, 0] % 2 == 0]
+        self.assert_array_equal(got, want)
+
+    def test_device_key_oob_clamps_to_logical_edge(self):
+        # getitem: reads clamp to row n-1 (jax device-key semantics),
+        # never the physical pad rows beyond it
+        host = np.arange(20, dtype=np.float32).reshape(10, 2)
+        x = ht.array(host, split=0)  # physical rows padded to 16 on 8 shards
+        got = x[jnp.asarray([9, 10, 500], jnp.int32)]
+        self.assert_array_equal(got, host[[9, 9, 9]])
+
+    def test_setitem_device_key_oob_clamps_not_pads(self):
+        # regression (ADVICE r5 #1): scatter with an OOB device key must
+        # land at logical row n-1 — a write into split-dim padding would
+        # vanish (reads slice padding off) and silently drop the update
+        host = np.zeros((10, 2), np.float32)
+        x = ht.array(host.copy(), split=0)
+        x[jnp.asarray([12], jnp.int32)] = 7.0
+        want = host.copy()
+        want[9] = 7.0
+        self.assert_array_equal(x, want)
+        # negative keys resolve against the LOGICAL extent
+        y = ht.array(host.copy(), split=0)
+        y[jnp.asarray([-1], jnp.int32)] = 3.0
+        want = host.copy()
+        want[-1] = 3.0
+        self.assert_array_equal(y, want)

@@ -219,6 +219,18 @@ class TestQRSVDMatrix(TestCase):
         self.assertIsNone(ronly.Q)
         np.testing.assert_allclose(ronly.R.numpy(), full.R.numpy(), rtol=1e-3, atol=1e-4)
 
+    def test_orthogonality_defect_probe(self):
+        # the opt-in companion to check="defer" (round 6): well-conditioned
+        # factors probe near f32 roundoff; a deliberately non-orthogonal
+        # matrix probes large
+        rng = np.random.default_rng(331)
+        host = rng.standard_normal((48, 8)).astype(np.float32)
+        q, _ = ht.linalg.qr(ht.array(host, split=None), check="defer")
+        d = ht.linalg.orthogonality_defect(q)
+        self.assertLess(float(d), 3e-4)  # ~sqrt(eps_f32) acceptance bar
+        bad = ht.array(np.ones((8, 3), np.float32))
+        self.assertGreater(float(ht.linalg.orthogonality_defect(bad)), 1.0)
+
     def test_svd_reconstruction(self):
         rng = np.random.default_rng(323)
         for (m, n) in [(64, 8), (40, 12)]:

@@ -126,6 +126,21 @@ class TestStackFamilyDeep(TestCase):
             r = ht.vstack([ht.array(self.x, split=s), ht.array(self.y, split=s)])
             self.assert_array_equal(r, expected)
 
+    def test_generator_inputs_not_exhausted(self):
+        # ADVICE r5 #4: the _require_dndarray pass used to exhaust
+        # generator inputs, leaving nothing for the actual stack
+        fams = [
+            (ht.stack, np.stack([self.x, self.y])),
+            (ht.vstack, np.vstack([self.x, self.y])),
+            (ht.hstack, np.hstack([self.x, self.y])),
+            (ht.dstack, np.dstack([self.x, self.y])),
+            (ht.column_stack, np.column_stack([self.x, self.y])),
+        ]
+        for fn, expected in fams:
+            with self.subTest(fn=fn.__name__):
+                gen = (ht.array(v, split=0) for v in (self.x, self.y))
+                self.assert_array_equal(fn(gen), expected)
+
     def test_vstack_1d_promotes(self):
         a, b = np.arange(5.0, dtype=np.float32), np.ones(5, dtype=np.float32)
         expected = np.vstack([a, b])
