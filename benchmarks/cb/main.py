@@ -10,6 +10,7 @@ import sys
 
 import cluster
 import config
+import fusion
 import linalg
 import manipulations
 import nn
@@ -78,13 +79,14 @@ if __name__ == "__main__":
     ap.add_argument(
         "--only",
         default=None,
-        help="comma-separated subset: linalg,cluster,manipulations,nn,regression",
+        help="comma-separated subset: linalg,cluster,manipulations,nn,regression,fusion",
     )
     args = ap.parse_args()
 
     suites = {
         "linalg": linalg.run,
         "cluster": cluster.run,
+        "fusion": fusion.run,
         "manipulations": manipulations.run,
         "nn": nn.run,
         "regression": regression.run,

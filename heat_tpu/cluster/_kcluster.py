@@ -219,13 +219,19 @@ class _KCluster(ClusteringMixin, BaseEstimator):
     def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
         """Assign each sample to its closest centroid (reference:
         _kcluster.py:196)."""
+        from ..core import statistics
+
+        # the distance update rides the fusion engine: a GSPMD cdist defers a
+        # lazy DAG and this argmin extends it, so distances + labels lower as
+        # one cached executable per (shape, sharding) key
         distances = self._metric(x, self._cluster_centers)
-        labels = jnp.argmin(distances.larray, axis=1, keepdims=True)
-        out = DNDarray(
-            labels, tuple(labels.shape), types.canonical_heat_type(labels.dtype),
-            x.split, x.device, x.comm,
-        )
-        return _ensure_split(out, x.split)
+        labels = statistics.argmin(distances, axis=1, keepdims=True)
+        if labels.split != x.split:
+            out = DNDarray(
+                labels.larray, labels.gshape, labels.dtype, x.split, x.device, x.comm
+            )
+            return _ensure_split(out, x.split)
+        return labels
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
         raise NotImplementedError()

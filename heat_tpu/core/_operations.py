@@ -26,18 +26,29 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import sanitation, types
+from . import fusion, sanitation, types
 from .dndarray import DNDarray, _ensure_split
 from .stride_tricks import broadcast_shape, sanitize_axes_for_reduction, sanitize_axis
 
 __all__ = ["_binary_op", "_local_op", "_reduce_op", "_cum_op"]
 
 
-def _as_operand(x, comm=None, device=None):
-    """Lift scalars / array-likes to (jax_value, split, is_scalar)."""
+def _as_operand(x, ref_dtype=None):
+    """Lift one binary-op operand to ``(value, split, is_scalar)``.
+
+    DNDarrays pass through with their split; array-likes become jnp arrays
+    (replicated, ``split=None``); python scalars promote against
+    ``ref_dtype`` with the reference's scalar-aware ``result_type`` rule
+    (types.py:868 — a scalar must not widen the array dtype; jax's
+    weak-type rules under x64 would take int32 + 1.5 to f64) and report
+    ``is_scalar=True``."""
     if isinstance(x, DNDarray):
-        return x, x.split
-    return x, None
+        return x, x.split, False
+    if np.isscalar(x):
+        if ref_dtype is not None:
+            return jnp.asarray(x, types.result_type(ref_dtype, x).jax_type()), None, True
+        return jnp.asarray(x), None, True
+    return jnp.asarray(x), None, False
 
 
 def _result_split(s1: Optional[int], s2: Optional[int], nd_out: int, nd1: int, nd2: int):
@@ -57,6 +68,42 @@ def _result_split(s1: Optional[int], s2: Optional[int], nd_out: int, nd1: int, n
     return m2
 
 
+def _lazy_operand(x, comm):
+    """DAG node for one operand of a fused op: lazy handles contribute their
+    pending expression, concrete DNDarrays their pinned physical buffer,
+    plain jax values a replicated leaf.  Mixed meshes cannot share one jitted
+    program — decline and let the eager path handle (or reject) them."""
+    if isinstance(x, DNDarray):
+        if x.comm is not comm and x.comm.mesh != comm.mesh:
+            raise fusion.Unfusable("operands live on different meshes")
+        return fusion.leaf_from(x)
+    return fusion.leaf(x)
+
+
+def _lazy_binary(operation, o1, o2, where, fn_kwargs, out_shape, split, device, comm):
+    n1 = _lazy_operand(o1, comm)
+    n2 = _lazy_operand(o2, comm)
+    res = fusion.node(operation, (n1, n2), **fn_kwargs)
+    if tuple(res.aval.shape) != tuple(out_shape):
+        # an operation with non-broadcast shape semantics: the eager path's
+        # actual-result-shape bookkeeping is authoritative
+        raise fusion.Unfusable("result shape disagrees with broadcast shape")
+    if where is not None:
+        wn = (
+            _lazy_operand(where, comm)
+            if isinstance(where, DNDarray)
+            else fusion.leaf(jnp.asarray(where))
+        )
+        base = fusion.node(
+            jnp.zeros, (), shape=tuple(out_shape), dtype=jnp.dtype(res.aval.dtype)
+        )
+        res = fusion.node(jnp.where, (wn, res, base))
+    return fusion.defer(
+        res, out_shape, types.canonical_heat_type(res.aval.dtype),
+        split, device, comm,
+    )
+
+
 def _binary_op(
     operation: Callable,
     t1,
@@ -65,7 +112,11 @@ def _binary_op(
     where=None,
     fn_kwargs: Optional[dict] = None,
 ) -> DNDarray:
-    """Generic distributed binary operation (reference: _operations.py:22)."""
+    """Generic distributed binary operation (reference: _operations.py:22).
+
+    With the fusion engine on (and no ``out=``), the op joins the lazy DAG
+    instead of dispatching: one leaf per operand, the ``where=`` select and
+    its zeros base as in-graph nodes, metadata predicted via eval_shape."""
     fn_kwargs = fn_kwargs or {}
 
     if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
@@ -74,39 +125,27 @@ def _binary_op(
     ref = t1 if isinstance(t1, DNDarray) else t2
     comm, device = ref.comm, ref.device
 
-    if isinstance(t1, DNDarray) and isinstance(t2, DNDarray):
-        a, b = t1.larray, t2.larray
-        s1, s2, nd1, nd2 = t1.split, t2.split, t1.ndim, t2.ndim
-        out_shape = broadcast_shape(t1.shape, t2.shape)
-    elif isinstance(t1, DNDarray):
-        a = t1.larray
-        b = t2.larray if isinstance(t2, DNDarray) else t2
-        if isinstance(b, (list, tuple, np.ndarray)):
-            b = jnp.asarray(b)
-        if np.isscalar(b):
-            # scalar-aware promotion (reference: result_type, types.py:868
-            # — a python scalar must not widen the array dtype): jax's
-            # weak-type rules under x64 would take int32 + 1.5 to f64
-            b = jnp.asarray(b, types.result_type(t1.dtype, b).jax_type())
-        s1, nd1 = t1.split, t1.ndim
-        s2, nd2 = None, (np.ndim(b) if not np.isscalar(b) else 0)
-        out_shape = broadcast_shape(t1.shape, np.shape(b))
-    else:
-        b = t2.larray
-        a = t1
-        if isinstance(a, (list, tuple, np.ndarray)):
-            a = jnp.asarray(a)
-        if np.isscalar(a):
-            a = jnp.asarray(a, types.result_type(t2.dtype, a).jax_type())
-        s2, nd2 = t2.split, t2.ndim
-        s1, nd1 = None, (np.ndim(a) if not np.isscalar(a) else 0)
-        out_shape = broadcast_shape(np.shape(a), t2.shape)
-
-    result = operation(a, b, **fn_kwargs)
-    split = _result_split(s1, s2, len(out_shape), nd1, nd2)
+    o1, s1, _ = _as_operand(t1, None if isinstance(t1, DNDarray) else ref.dtype)
+    o2, s2, _ = _as_operand(t2, None if isinstance(t2, DNDarray) else ref.dtype)
+    sh1 = o1.shape if isinstance(o1, DNDarray) else np.shape(o1)
+    sh2 = o2.shape if isinstance(o2, DNDarray) else np.shape(o2)
+    out_shape = broadcast_shape(sh1, sh2)
+    split = _result_split(s1, s2, len(out_shape), len(sh1), len(sh2))
     # a broadcast dimension of size 1 at the split cannot stay split
     if split is not None and out_shape and out_shape[split] <= 1:
         split = None
+
+    if fusion.enabled() and out is None:
+        try:
+            return _lazy_binary(
+                operation, o1, o2, where, fn_kwargs, out_shape, split, device, comm
+            )
+        except fusion.Unfusable:
+            fusion.count_fallback()
+
+    a = o1.larray if isinstance(o1, DNDarray) else o1
+    b = o2.larray if isinstance(o2, DNDarray) else o2
+    result = operation(a, b, **fn_kwargs)
 
     if where is not None:
         wh = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
@@ -134,8 +173,22 @@ def _local_op(
 ) -> DNDarray:
     """Elementwise operation with float-cast policy (reference:
     _operations.py:307): integer inputs are promoted to the default float type
-    for transcendental ops unless ``no_cast``."""
+    for transcendental ops unless ``no_cast``.  Under fusion the float-cast
+    joins the DAG as a cast node — convert + op lower as one program."""
     sanitation.sanitize_in(x)
+    if fusion.enabled() and out is None:
+        try:
+            nx = _lazy_operand(x, x.comm)
+            if not no_cast and not jnp.issubdtype(nx.aval.dtype, jnp.inexact):
+                nx = fusion.cast_node(nx, jnp.float32)
+            res = fusion.node(operation, (nx,), **kwargs)
+            return fusion.defer(
+                res, res.aval.shape, types.canonical_heat_type(res.aval.dtype),
+                x.split if len(res.aval.shape) == x.ndim else None,
+                x.device, x.comm,
+            )
+        except fusion.Unfusable:
+            fusion.count_fallback()
     arr = x.larray
     if not no_cast and not jnp.issubdtype(arr.dtype, jnp.inexact):
         arr = arr.astype(jnp.float32)
@@ -152,6 +205,53 @@ def _local_op(
     return wrapped
 
 
+def _reduce_split(split, axes, keepdims: bool, out_ndim: int):
+    """Result split of a reduction (reference: reduced-away split →
+    replicated; retained dims keep the index, dropped leading axes shift
+    it down)."""
+    if split is not None:
+        if split in axes:
+            split = None
+        elif keepdims:
+            pass  # dims retained, split index unchanged
+        else:
+            split -= sum(1 for ax in axes if ax < split)
+    if out_ndim == 0:
+        split = None
+    return split
+
+
+def _lazy_reduce(operation, x, axes, call_axis, keepdims, dtype, kwargs):
+    nx = _lazy_operand(x, x.comm)
+    if dtype is not None:
+        nx = fusion.cast_node(nx, types.canonical_heat_type(dtype).jax_type())
+    # 16-bit float accumulation contract (see the eager path below): probe
+    # the op for a dtype kwarg via shape inference and ride the f32
+    # accumulator + cast-back inside the same fused program
+    half = (
+        jnp.issubdtype(nx.aval.dtype, jnp.floating)
+        and jnp.dtype(nx.aval.dtype).itemsize < 4
+    )
+    res = None
+    if half and dtype is None:
+        try:
+            res = fusion.node(
+                operation, (nx,),
+                axis=call_axis, keepdims=keepdims, dtype=jnp.float32, **kwargs
+            )
+        except fusion.Unfusable:
+            res = None
+        if res is not None and jnp.issubdtype(res.aval.dtype, jnp.floating):
+            res = fusion.cast_node(res, nx.aval.dtype)
+    if res is None:
+        res = fusion.node(operation, (nx,), axis=call_axis, keepdims=keepdims, **kwargs)
+    split = _reduce_split(x.split, axes, keepdims, len(res.aval.shape))
+    return fusion.defer(
+        res, res.aval.shape, types.canonical_heat_type(res.aval.dtype),
+        split, x.device, x.comm,
+    )
+
+
 def _reduce_op(
     operation: Callable,
     x: DNDarray,
@@ -164,13 +264,20 @@ def _reduce_op(
 ) -> DNDarray:
     """Generic reduction (reference: _operations.py:381). The reference's
     local-reduce + Allreduce + neutral-fill dance is a single jnp call; XLA
-    inserts the cross-device reduce when the split axis participates."""
+    inserts the cross-device reduce when the split axis participates.  Under
+    fusion a trailing reduction extends its producer chain's DAG, so e.g.
+    ``((x - mu) / sd).sum(axis=1)`` lowers as one executable."""
     sanitation.sanitize_in(x)
     axes, was_none = sanitize_axes_for_reduction(x.shape, axis)
+    call_axis = None if was_none else (axes if len(axes) > 1 else axes[0])
+    if fusion.enabled() and out is None:
+        try:
+            return _lazy_reduce(operation, x, axes, call_axis, keepdims, dtype, kwargs)
+        except fusion.Unfusable:
+            fusion.count_fallback()
     arr = x.larray
     if dtype is not None:
         arr = arr.astype(types.canonical_heat_type(dtype).jax_type())
-    call_axis = None if was_none else (axes if len(axes) > 1 else axes[0])
     # 16-bit float inputs accumulate in f32 and cast back (NumPy's fp16
     # contract): a bf16 accumulator saturates after ~256 terms — the mean
     # of 1e9 standard normals came out at 1e-2 instead of ~3e-5.  The f32
@@ -193,17 +300,7 @@ def _reduce_op(
     if result is None:
         result = operation(arr, axis=call_axis, keepdims=keepdims, **kwargs)
 
-    # result split (reference: reduced-away split → replicated)
-    split = x.split
-    if split is not None:
-        if split in axes:
-            split = None
-        elif keepdims:
-            pass  # dims retained, split index unchanged
-        else:
-            split -= sum(1 for ax in axes if ax < split)
-    if np.ndim(result) == 0:
-        split = None
+    split = _reduce_split(x.split, axes, keepdims, np.ndim(result))
 
     wrapped = DNDarray(
         result, tuple(result.shape), types.canonical_heat_type(result.dtype),
@@ -230,6 +327,18 @@ def _cum_op(
     axis = sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative ops require an axis")
+    if fusion.enabled() and out is None:
+        try:
+            nx = _lazy_operand(x, x.comm)
+            if dtype is not None:
+                nx = fusion.cast_node(nx, types.canonical_heat_type(dtype).jax_type())
+            res = fusion.node(operation, (nx,), axis=axis)
+            return fusion.defer(
+                res, res.aval.shape, types.canonical_heat_type(res.aval.dtype),
+                x.split, x.device, x.comm,
+            )
+        except fusion.Unfusable:
+            fusion.count_fallback()
     arr = x.larray
     if dtype is not None:
         arr = arr.astype(types.canonical_heat_type(dtype).jax_type())

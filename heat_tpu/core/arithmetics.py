@@ -284,3 +284,31 @@ def _bind_operators():
 
 
 _bind_operators()
+
+# ------------------------------------------------------------- fusion table
+# Display metadata for the jnp kernels this module routes through the lazy
+# engine (core/fusion.py): fingerprints key on the function objects; the
+# table names them in describe()/debug output and tags their role.
+from . import fusion as _fusion  # noqa: E402
+
+for _fn, _name in [
+    (jnp.add, "add"), (jnp.subtract, "sub"), (jnp.multiply, "mul"),
+    (jnp.true_divide, "div"), (jnp.floor_divide, "floordiv"),
+    (jnp.mod, "mod"), (jnp.fmod, "fmod"), (jnp.power, "pow"),
+    (jnp.hypot, "hypot"), (jnp.copysign, "copysign"),
+    (jnp.left_shift, "lshift"), (jnp.right_shift, "rshift"),
+    (jnp.bitwise_and, "and"), (jnp.bitwise_or, "or"),
+    (jnp.bitwise_xor, "xor"),
+]:
+    _fusion.register_op(_fn, _name, kind="elementwise")
+for _fn, _name in [
+    (jnp.negative, "neg"), (jnp.positive, "pos"), (jnp.bitwise_not, "invert"),
+]:
+    _fusion.register_op(_fn, _name, kind="elementwise")
+for _fn, _name in [
+    (jnp.sum, "sum"), (jnp.prod, "prod"),
+    (jnp.nansum, "nansum"), (jnp.nanprod, "nanprod"),
+]:
+    _fusion.register_op(_fn, _name, kind="reduction")
+for _fn, _name in [(jnp.cumsum, "cumsum"), (jnp.cumprod, "cumprod")]:
+    _fusion.register_op(_fn, _name, kind="scan")

@@ -460,9 +460,14 @@ class DNDarray:
         if axis == self.__split:
             return self
         if transport.resplit_applicable(self.__gshape, self.__split, axis, self.__comm):
+            # a pending fused expression may hold this buffer as a DAG leaf;
+            # donating it would make that chain's later materialization a
+            # use-after-free — fall back to a non-donating move then
+            from .fusion import safe_to_donate
+
             self.__array = transport.tiled_resplit(
                 self.__array, self.__gshape, self.__split, axis, self.__comm,
-                donate=True,
+                donate=safe_to_donate(self.__array),
             )
         else:
             self.__array = _to_physical(self.larray, self.__gshape, axis, self.__comm)

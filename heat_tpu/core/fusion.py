@@ -1,0 +1,519 @@
+"""Fused op-chain execution: lazy expressions + a sharding-aware compile cache.
+
+The reference executes one torch call (plus one optional MPI collective) per
+operator; the eager port kept that shape, so a chain like ``(x - mu) / sd``
+dispatches N separate XLA programs with no cross-op fusion, and re-traces
+whenever the same chain recurs through a new Python call path.  This module
+makes the `_operations.py` workhorses *lazy*: elementwise ops, dtype casts,
+``where=`` masks and trailing reductions accumulate into a small op-DAG (an
+:class:`Expr` per node), and the whole DAG lowers as ONE jitted XLA
+computation at a materialization boundary — ``.larray`` access, a
+split-changing op, I/O, or a comparison used in Python control flow (all of
+which read the mangled ``_DNDarray__array`` slot and therefore funnel through
+:class:`LazyDNDarray.__getattr__`).
+
+Compiled executables are cached under
+``(op-graph fingerprint, leaf avals + NamedShardings, target layout)`` with
+hit/miss counters exposed via :func:`cache_stats`, so steady-state serving
+traffic pays zero retrace.  Scalars enter the graph as 0-d array *inputs*
+(never baked constants): the fingerprint is value-independent and a chain
+re-run with a different scalar is a cache hit.
+
+Donation-awareness: inside a fused program the intermediates of the chain
+never materialize (XLA reuses their buffers), the pad-to-physical +
+``with_sharding_constraint`` finalization happens in-program instead of as a
+separate dispatch, and the compile layer honors ``donate`` indices
+(``jax.jit(donate_argnums=...)``) for callers that hand over a dead input
+buffer.  The engine also cooperates with the PR-1 transport engine's
+donating ``resplit_``: leaf buffers captured by still-pending expressions
+are *pinned* (:func:`safe_to_donate`) so a donating in-place resplit cannot
+invalidate a lazy chain built before it.
+
+``HEAT_TPU_FUSE=off`` (or ``0``/``false``) restores fully eager execution
+for debugging; :func:`fuse` is the scoped equivalent.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import types
+from .dndarray import DNDarray, _physical_dim
+
+__all__ = [
+    "LazyDNDarray",
+    "Unfusable",
+    "cache_stats",
+    "defer",
+    "enabled",
+    "fuse",
+    "last_hlo",
+    "leaf",
+    "leaf_from",
+    "node",
+    "op_name",
+    "register_op",
+    "reset_cache",
+    "safe_to_donate",
+    "set_enabled",
+]
+
+
+# --------------------------------------------------------------- env switch
+
+def _env_enabled() -> bool:
+    return os.environ.get("HEAT_TPU_FUSE", "on").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+_ENABLED = _env_enabled()
+
+
+def enabled() -> bool:
+    """Whether the lazy fusion engine is active (``HEAT_TPU_FUSE``)."""
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> bool:
+    """Switch the engine on/off; returns the previous state."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(flag)
+    return prev
+
+
+@contextmanager
+def fuse(flag: bool = True):
+    """Scoped :func:`set_enabled` (``with fusion.fuse(False): ...``)."""
+    prev = set_enabled(flag)
+    try:
+        yield
+    finally:
+        set_enabled(prev)
+
+
+class Unfusable(Exception):
+    """Raised while building a lazy node when the op cannot enter the DAG
+    (unhashable static kwargs, shape inference failure, mixed meshes).
+    Callers fall back to the eager path — which either succeeds or raises
+    the proper user-facing error."""
+
+
+# ----------------------------------------------------------------- op table
+# Registered metadata for the fns that flow through the engine: a stable
+# display name (fingerprints key on the function OBJECT — qualnames are
+# unsafe, closures with different static state share them) and a kind tag.
+# Registration is optional: unregistered callables fuse too, they just
+# print as their __name__ in describe()/debug output.
+
+_OP_TABLE: "dict[Callable, Tuple[str, str]]" = {}
+
+
+def register_op(fn: Callable, name: str, kind: str = "elementwise") -> Callable:
+    """Record display metadata for ``fn`` (see arithmetics/relational/logical
+    module bottoms for the standard tables)."""
+    _OP_TABLE[fn] = (name, kind)
+    return fn
+
+
+def op_name(fn: Callable) -> str:
+    meta = _OP_TABLE.get(fn)
+    if meta is not None:
+        return meta[0]
+    return getattr(fn, "__name__", repr(fn))
+
+
+# -------------------------------------------------------------- buffer pins
+# id(array) -> live-pin count.  A pin means: some still-pending Expr leaf
+# holds this exact jax.Array strongly (so the id cannot be recycled while
+# the entry exists).  resplit_ consults safe_to_donate() before handing the
+# buffer to the transport engine's donating all-to-all.
+
+_PINNED: "dict[int, int]" = {}
+
+
+def _unpin(buf_id: int) -> None:
+    n = _PINNED.get(buf_id, 0) - 1
+    if n > 0:
+        _PINNED[buf_id] = n
+    else:
+        _PINNED.pop(buf_id, None)
+
+
+def _pin(expr: "Expr", value) -> None:
+    buf_id = id(value)
+    _PINNED[buf_id] = _PINNED.get(buf_id, 0) + 1
+    weakref.finalize(expr, _unpin, buf_id)
+
+
+def safe_to_donate(value) -> bool:
+    """False iff a pending lazy expression still references ``value`` as a
+    leaf — donating it would turn later materialization into a
+    use-after-free (``Array has been deleted``)."""
+    return id(value) not in _PINNED
+
+
+# ------------------------------------------------------------------ op-DAG
+
+class Expr:
+    """One node of the lazy DAG.
+
+    Leaf: ``value`` is a concrete jax.Array (physical — possibly padded — or
+    logical) and ``lshape`` its logical shape.  Op node: ``fn`` applied to
+    ``args`` with static ``kwargs``; ``aval`` is the eval_shape-predicted
+    result.  Materialization *leafifies* the node in place (sets ``value``,
+    drops ``fn``/``args``) so diamond DAGs never recompute a subchain."""
+
+    __slots__ = ("fn", "args", "kwargs", "aval", "value", "lshape", "__weakref__")
+
+    def __init__(self, fn, args, kwargs, aval, value=None, lshape=None):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.aval = aval
+        self.value = value
+        self.lshape = lshape
+
+    def leafify(self, value, lshape) -> None:
+        self.value = value
+        self.lshape = tuple(lshape)
+        self.fn = None
+        self.args = ()
+        self.kwargs = None
+
+
+def leaf(value, lshape=None, pin: bool = False) -> Expr:
+    """Wrap a concrete jax array as a DAG leaf.  ``lshape`` is the logical
+    shape when ``value`` carries even-chunk physical padding."""
+    lshape = tuple(value.shape) if lshape is None else tuple(lshape)
+    aval = jax.ShapeDtypeStruct(lshape, value.dtype)
+    e = Expr(None, (), None, aval, value=value, lshape=lshape)
+    if pin:
+        _pin(e, value)
+    return e
+
+
+def leaf_from(x: DNDarray) -> Expr:
+    """Leaf (or pending sub-DAG) for a DNDarray operand.  Lazy handles
+    contribute their expression — consumer chains extend the producer's DAG
+    instead of forcing it.  Concrete handles contribute their *physical*
+    array (the program slices the pad off), pinned against donation."""
+    if isinstance(x, LazyDNDarray) and "_DNDarray__array" not in x.__dict__:
+        e = x._expr
+        if e is not None:
+            return e
+    return leaf(x.parray, x.gshape, pin=True)
+
+
+def _kwargs_key(kwargs) -> tuple:
+    if not kwargs:
+        return ()
+    try:
+        items = tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))
+        hash(items)
+    except TypeError as err:
+        raise Unfusable(f"unhashable static kwargs: {kwargs!r}") from err
+    return items
+
+
+# eval_shape is O(1) per op but not free; memoize on (fn, child avals,
+# static kwargs).  LRU-capped: stray per-call closures must not grow it
+# unboundedly over a long serving process.
+_AVAL_MEMO: "OrderedDict[tuple, jax.ShapeDtypeStruct]" = OrderedDict()
+_AVAL_MEMO_MAX = 4096
+
+
+def _infer_aval(fn, child_avals, kw_key):
+    key = (fn, tuple((a.shape, str(a.dtype)) for a in child_avals), kw_key)
+    try:
+        out = _AVAL_MEMO[key]
+        _AVAL_MEMO.move_to_end(key)
+        return out
+    except KeyError:
+        pass
+    except TypeError as err:  # unhashable fn
+        raise Unfusable(f"unhashable op {fn!r}") from err
+    kwargs = dict(kw_key)
+    try:
+        out = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *child_avals)
+    except Unfusable:
+        raise
+    except Exception as err:
+        raise Unfusable(f"shape inference failed for {op_name(fn)}: {err}") from err
+    if not isinstance(out, jax.ShapeDtypeStruct):
+        raise Unfusable(f"{op_name(fn)} does not return a single array")
+    _AVAL_MEMO[key] = out
+    if len(_AVAL_MEMO) > _AVAL_MEMO_MAX:
+        _AVAL_MEMO.popitem(last=False)
+    return out
+
+
+def node(fn: Callable, args: Tuple[Expr, ...], **kwargs) -> Expr:
+    """Apply ``fn`` lazily to child nodes with static ``kwargs``.  Metadata
+    (shape/dtype) is predicted via ``jax.eval_shape`` — no execution."""
+    kw_key = _kwargs_key(kwargs)
+    aval = _infer_aval(fn, tuple(a.aval for a in args), kw_key)
+    return Expr(fn, tuple(args), kw_key, aval)
+
+
+def _astype(t, dtype):
+    return t.astype(dtype)
+
+
+register_op(_astype, "astype", kind="cast")
+
+
+def cast_node(child: Expr, dtype) -> Expr:
+    """Lazy dtype cast (fuses into the chain; no array-sized copy)."""
+    if str(child.aval.dtype) == str(jnp.dtype(dtype)):
+        return child
+    return node(_astype, (child,), dtype=jnp.dtype(dtype))
+
+
+def describe(expr: Expr) -> str:
+    """Human-readable postorder rendering of the DAG (debugging aid)."""
+    instrs, leaves, out_slot = _linearize(expr)
+    lines = []
+    for i, ins in enumerate(instrs):
+        if ins[0] == "L":
+            lf = leaves[ins[1]]
+            lines.append(f"%{i} = leaf{tuple(lf.lshape)}:{lf.value.dtype}")
+        else:
+            _, fn, kw, ch = ins
+            kws = f" {dict(kw)}" if kw else ""
+            lines.append(f"%{i} = {op_name(fn)}({', '.join('%%%d' % c for c in ch)}){kws}")
+    lines.append(f"return %{out_slot}")
+    return "\n".join(lines)
+
+
+# -------------------------------------------------- fingerprint + lowering
+
+def _linearize(root: Expr):
+    """Postorder-linearize the DAG into ``(instrs, leaves, out_slot)``.
+
+    ``instrs`` is the canonical serialization the compile cache keys on:
+    leaves become ``("L", leaf_index)`` numbered by first encounter, op
+    nodes ``("O", fn, kwargs_key, child_slots)``.  Shared subgraphs get one
+    slot (a diamond serializes each node once)."""
+    instrs = []
+    leaves = []
+    slot: "dict[int, int]" = {}
+    leaf_slot: "dict[tuple, int]" = {}
+    keepalive = []  # id()-keyed dict needs the nodes alive for the walk
+
+    def visit(n: Expr) -> int:
+        nid = id(n)
+        if nid in slot:
+            return slot[nid]
+        keepalive.append(n)
+        if n.value is not None:
+            # two leaf nodes wrapping the same buffer collapse to one
+            # program input (x appearing twice in a chain is one arg)
+            lk = (id(n.value), tuple(n.lshape))
+            if lk in leaf_slot:
+                slot[nid] = leaf_slot[lk]
+                return slot[nid]
+            leaves.append(n)
+            instrs.append(("L", len(leaves) - 1))
+            leaf_slot[lk] = len(instrs) - 1
+        else:
+            ch = tuple(visit(c) for c in n.args)
+            instrs.append(("O", n.fn, n.kwargs, ch))
+        slot[nid] = len(instrs) - 1
+        return slot[nid]
+
+    out_slot = visit(root)
+    return tuple(instrs), leaves, out_slot
+
+
+def _build_program(instrs, out_slot, lshapes, gshape, split, nshards, target):
+    """The single fused computation for one cache entry: slice leaf pads to
+    logical, evaluate the DAG, pad the result to its physical shape and pin
+    the canonical NamedSharding — the whole `_ensure_split` finalization
+    happens *inside* the program instead of as a separate dispatch."""
+
+    def program(*vals):
+        env = []
+        for ins in instrs:
+            if ins[0] == "L":
+                v = vals[ins[1]]
+                ls = lshapes[ins[1]]
+                if tuple(v.shape) != ls:
+                    v = v[tuple(slice(0, n) for n in ls)]
+                env.append(v)
+            else:
+                _, fn, kw, ch = ins
+                env.append(fn(*[env[c] for c in ch], **dict(kw or ())))
+        out = env[out_slot]
+        if split is not None and gshape:
+            n = gshape[split]
+            pn = _physical_dim(n, nshards)
+            if pn != n:
+                pad = [(0, 0)] * len(gshape)
+                pad[split] = (0, pn - n)
+                out = jnp.pad(out, pad)
+        return jax.lax.with_sharding_constraint(out, target)
+
+    return program
+
+
+# ------------------------------------------------------------ compile cache
+
+class _Entry:
+    __slots__ = ("jitted", "avals", "hits")
+
+    def __init__(self, jitted, avals):
+        self.jitted = jitted
+        self.avals = avals
+        self.hits = 0
+
+
+_CACHE: "OrderedDict[tuple, _Entry]" = OrderedDict()
+_CACHE_MAX = int(os.environ.get("HEAT_TPU_FUSE_CACHE_SIZE", "4096"))
+_STATS = {"hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0}
+
+
+def cache_stats() -> dict:
+    """Counters for the executable cache: ``hits``/``misses`` (lookups),
+    ``size`` (live entries), ``evictions`` (LRU drops past
+    ``HEAT_TPU_FUSE_CACHE_SIZE``), ``fallbacks`` (ops that declined to fuse
+    and ran eagerly).  A serving steady state shows misses flat and hits
+    climbing — a miss on a repeated chain is a retrace regression."""
+    return {"size": len(_CACHE), **_STATS}
+
+
+def reset_cache() -> None:
+    """Drop all executables and zero the counters (tests/benchmarks)."""
+    _CACHE.clear()
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def count_fallback() -> None:
+    _STATS["fallbacks"] += 1
+
+
+def last_hlo() -> Optional[str]:
+    """Compiled HLO text of the most recently used cache entry (census
+    tests count modules/ops in it).  None when the cache is empty."""
+    if not _CACHE:
+        return None
+    entry = next(reversed(_CACHE.values()))
+    return entry.jitted.lower(*entry.avals).compile().as_text()
+
+
+def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
+    """Lower ``expr`` (or fetch the cached executable) and run it."""
+    instrs, leaves, out_slot = _linearize(expr)
+    vals = [lf.value for lf in leaves]
+    lshapes = tuple(tuple(lf.lshape) for lf in leaves)
+    target = comm.sharding(split, len(gshape))
+    sig = tuple(
+        (tuple(v.shape), str(v.dtype), getattr(v, "sharding", None))
+        for v in vals
+    )
+    key = (instrs, out_slot, lshapes, sig, tuple(gshape), split, target, donate)
+    entry = _CACHE.get(key)
+    if entry is None:
+        _STATS["misses"] += 1
+        program = _build_program(
+            instrs, out_slot, lshapes, tuple(gshape), split, comm.size, target
+        )
+        jitted = jax.jit(program, donate_argnums=donate or ())
+        # only mesh shardings are recorded for AOT re-lowering (last_hlo):
+        # a SingleDeviceSharding on an uncommitted scalar leaf would pin it
+        # to device 0 and clash with the mesh-committed array leaves
+        avals = tuple(
+            jax.ShapeDtypeStruct(
+                v.shape, v.dtype,
+                sharding=s if isinstance(s, jax.sharding.NamedSharding) else None,
+            )
+            for v in vals
+            for s in (getattr(v, "sharding", None),)
+        )
+        entry = _Entry(jitted, avals)
+        _CACHE[key] = entry
+        while len(_CACHE) > _CACHE_MAX:
+            _CACHE.popitem(last=False)
+            _STATS["evictions"] += 1
+    else:
+        _STATS["hits"] += 1
+        entry.hits += 1
+        _CACHE.move_to_end(key)
+    return entry.jitted(*vals)
+
+
+# ----------------------------------------------------------- lazy DNDarray
+
+class LazyDNDarray(DNDarray):
+    """A DNDarray whose payload is a pending :class:`Expr`.
+
+    All metadata (shape, dtype, split, device, comm) is exact and available
+    immediately — only the array value is deferred.  Every base-class code
+    path that reads the mangled ``_DNDarray__array`` slot (``.larray``,
+    ``.parray``, ``__bool__``, ``resplit_``, printing, ``numpy()``, ...)
+    triggers ``__getattr__`` on the missing slot, which materializes the
+    DAG through the compile cache and caches the physical result — the
+    materialization boundaries of the ISSUE fall out of attribute access,
+    with zero changes to the call sites."""
+
+    def __init__(self, expr, gshape, dtype, split, device, comm):
+        super().__init__(None, gshape, dtype, split, device, comm)
+        object.__setattr__(self, "_expr", expr)
+        del self._DNDarray__array
+
+    def __getattr__(self, name):
+        if name == "_DNDarray__array":
+            expr = self._expr
+            value = _run(expr, self.gshape, self.split, self.comm)
+            # leafify in place: later chains referencing this node reuse
+            # the computed buffer instead of recompiling the subchain.
+            # The buffer is pinned for the node's remaining lifetime (it
+            # may now be a leaf of other pending DAGs) and the handle
+            # drops its expression reference, so the pin dies with the
+            # last consumer rather than with this handle.
+            expr.leafify(value, self.gshape)
+            _pin(expr, value)
+            object.__setattr__(self, "_DNDarray__array", value)
+            object.__setattr__(self, "_expr", None)
+            return value
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    def astype(self, dtype, copy: bool = True) -> "DNDarray":
+        # still pending + copy=True: the cast joins the DAG (a fused
+        # convert_element_type, never an array-sized dispatch)
+        if copy and "_DNDarray__array" not in self.__dict__:
+            ht_dtype = types.canonical_heat_type(dtype)
+            try:
+                casted = cast_node(self._expr, ht_dtype.jax_type())
+            except Unfusable:
+                return super().astype(dtype, copy)
+            return LazyDNDarray(
+                casted, self.gshape, ht_dtype, self.split, self.device, self.comm
+            )
+        return super().astype(dtype, copy)
+
+
+def defer(expr: Expr, gshape, dtype, split, device, comm) -> LazyDNDarray:
+    """Wrap a DAG root as a lazy DNDarray with the given result metadata."""
+    return LazyDNDarray(
+        expr, tuple(gshape), dtype, split, device, comm
+    )
+
+
+def materialize(x: DNDarray) -> DNDarray:
+    """Force a (possibly lazy) DNDarray to its concrete physical payload."""
+    x.parray  # property read funnels through __getattr__ when pending
+    return x

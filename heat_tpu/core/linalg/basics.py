@@ -62,8 +62,10 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
                 f"matmul: inner dimensions do not match: {a.shape} @ {b.shape}"
             )
     promoted = types.promote_types(a.dtype, b.dtype)
-    av = a.larray.astype(promoted.jax_type())
-    bv = b.larray.astype(promoted.jax_type())
+    # astype on a matching dtype still copies under donation-less dispatch;
+    # skip it so same-dtype matmuls read the operand buffers in place
+    av = a.larray if a.dtype == promoted else a.larray.astype(promoted.jax_type())
+    bv = b.larray if b.dtype == promoted else b.larray.astype(promoted.jax_type())
     result = jnp.matmul(av, bv)
 
     nd_out = result.ndim

@@ -91,3 +91,19 @@ def signbit(x, out=None) -> DNDarray:
 
 DNDarray.all = lambda self, axis=None, out=None, keepdims=False: all(self, axis, out, keepdims)
 DNDarray.any = lambda self, axis=None, out=None, keepdims=False: any(self, axis, out, keepdims)
+
+# fusion op table (see arithmetics.py)
+from . import fusion as _fusion  # noqa: E402
+
+for _fn, _name in [
+    (jnp.logical_and, "logical_and"), (jnp.logical_or, "logical_or"),
+    (jnp.logical_xor, "logical_xor"), (jnp.logical_not, "logical_not"),
+    (jnp.isclose, "isclose"), (jnp.isfinite, "isfinite"),
+    (jnp.isinf, "isinf"), (jnp.isnan, "isnan"),
+    (jnp.isneginf, "isneginf"), (jnp.isposinf, "isposinf"),
+    (jnp.signbit, "signbit"),
+]:
+    _fusion.register_op(_fn, _name, kind="predicate")
+for _fn, _name in [(jnp.all, "all"), (jnp.any, "any")]:
+    _fusion.register_op(_fn, _name, kind="reduction")
+

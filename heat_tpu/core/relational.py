@@ -75,3 +75,15 @@ def _bind_operators():
 
 
 _bind_operators()
+
+# fusion op table (see arithmetics.py): comparisons are elementwise nodes —
+# a relational tail on a fused chain stays in the same executable, and the
+# Python-control-flow __bool__ on the result is the materialization boundary
+from . import fusion as _fusion  # noqa: E402
+
+for _fn, _name in [
+    (jnp.equal, "eq"), (jnp.not_equal, "ne"), (jnp.less, "lt"),
+    (jnp.less_equal, "le"), (jnp.greater, "gt"), (jnp.greater_equal, "ge"),
+]:
+    _fusion.register_op(_fn, _name, kind="comparison")
+

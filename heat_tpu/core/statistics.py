@@ -46,20 +46,58 @@ __all__ = [
 ]
 
 
+# Module-level singleton kernels: the fusion engine fingerprints op-DAGs by
+# the function OBJECT (qualnames are unsafe — the old per-call lambdas here
+# closed over ddof, so two same-named closures could mean different math).
+# One stable object per statistic, with ddof & friends as static kwargs,
+# makes repeated mean/var/std pipelines hit the compile cache instead of
+# re-tracing every call.
+
+def _float_acc(t):
+    """The float-cast policy of the statistics family: integers accumulate
+    in the default float type, floats keep their precision."""
+    return t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32)
+
+
+def _argmax_kernel(t, axis=None, keepdims=False):
+    return jnp.argmax(t, axis=axis, keepdims=keepdims)
+
+
+def _argmin_kernel(t, axis=None, keepdims=False):
+    return jnp.argmin(t, axis=axis, keepdims=keepdims)
+
+
+def _mean_kernel(t, axis=None, keepdims=False, dtype=None):
+    return jnp.mean(_float_acc(t), axis=axis, keepdims=keepdims, dtype=dtype)
+
+
+def _std_kernel(t, axis=None, keepdims=False, dtype=None, ddof=0):
+    return jnp.std(_float_acc(t), axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype)
+
+
+def _var_kernel(t, axis=None, keepdims=False, dtype=None, ddof=0):
+    return jnp.var(_float_acc(t), axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype)
+
+
+for _k, _n in [
+    (_argmax_kernel, "argmax"), (_argmin_kernel, "argmin"),
+    (_mean_kernel, "mean"), (_std_kernel, "std"), (_var_kernel, "var"),
+]:
+    _operations.fusion.register_op(_k, _n, kind="reduction")
+
+
 def argmax(x, axis=None, out=None, keepdims=False) -> DNDarray:
     """Index of the maximum (reference: statistics.py:46 — twin-payload MPI op
     there, one jnp.argmax here)."""
     return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False: jnp.argmax(t, axis=axis, keepdims=keepdims),
-        x, axis=axis, out=out, keepdims=keepdims,
+        _argmax_kernel, x, axis=axis, out=out, keepdims=keepdims
     )
 
 
 def argmin(x, axis=None, out=None, keepdims=False) -> DNDarray:
     """Index of the minimum (reference: statistics.py:117)."""
     return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False: jnp.argmin(t, axis=axis, keepdims=keepdims),
-        x, axis=axis, out=out, keepdims=keepdims,
+        _argmin_kernel, x, axis=axis, out=out, keepdims=keepdims
     )
 
 
@@ -225,14 +263,10 @@ def maximum(x1, x2, out=None, where=None) -> DNDarray:
 def mean(x, axis=None, keepdims: bool = False) -> DNDarray:
     """Arithmetic mean (reference: statistics.py:892 — merged-moments
     Allreduce there, one partitioned jnp.mean here; ``keepdims`` is a
-    numpy-parity extension the reference lacks)."""
-    return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False, dtype=None: jnp.mean(
-            t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
-            axis=axis, keepdims=keepdims, dtype=dtype,
-        ),
-        x, axis=axis, keepdims=keepdims,
-    )
+    numpy-parity extension the reference lacks).  Under fusion, a pipeline
+    like ``(x - x.mean(0)) / x.std(0)`` accumulates into one lazy DAG and
+    lowers as a single cached executable."""
+    return _operations._reduce_op(_mean_kernel, x, axis=axis, keepdims=keepdims)
 
 
 def median(x, axis=None, keepdims=False) -> DNDarray:
@@ -334,13 +368,11 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
 
 
 def std(x, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarray:
-    """Standard deviation (reference: statistics.py:1724)."""
+    """Standard deviation (reference: statistics.py:1724).  ``ddof`` rides
+    as a static kwarg on the singleton kernel so every call shares one
+    fusion fingerprint per ddof value."""
     return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False, dtype=None: jnp.std(
-            t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
-            axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype,
-        ),
-        x, axis=axis, keepdims=keepdims,
+        _std_kernel, x, axis=axis, keepdims=keepdims, ddof=ddof
     )
 
 
@@ -348,11 +380,7 @@ def var(x, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarray:
     """Variance (reference: statistics.py:1857 — Bennett merged moments there,
     one partitioned jnp.var here)."""
     return _operations._reduce_op(
-        lambda t, axis=None, keepdims=False, dtype=None: jnp.var(
-            t if jnp.issubdtype(t.dtype, jnp.inexact) else t.astype(jnp.float32),
-            axis=axis, ddof=ddof, keepdims=keepdims, dtype=dtype,
-        ),
-        x, axis=axis, keepdims=keepdims,
+        _var_kernel, x, axis=axis, keepdims=keepdims, ddof=ddof
     )
 
 

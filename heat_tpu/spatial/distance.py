@@ -23,7 +23,9 @@ from ..core.dndarray import DNDarray, _ensure_split
 __all__ = ["cdist", "rbf", "manhattan"]
 
 
-def _prep(x: DNDarray, y: Optional[DNDarray]):
+def _check(x: DNDarray, y: Optional[DNDarray]):
+    """Validate operands and compute the promoted dtype from metadata only —
+    no ``.larray`` read, so a lazy operand stays lazy on the fused path."""
     sanitation.sanitize_in(x)
     if y is None:
         y = x
@@ -32,10 +34,15 @@ def _prep(x: DNDarray, y: Optional[DNDarray]):
         raise ValueError("cdist requires 2-D inputs")
     if x.shape[1] != y.shape[1]:
         raise ValueError(f"feature dimensions differ: {x.shape[1]} vs {y.shape[1]}")
-    xa, ya = x.larray, y.larray
-    promoted = jnp.promote_types(xa.dtype, ya.dtype)
+    promoted = jnp.promote_types(x.dtype.jax_type(), y.dtype.jax_type())
     if not jnp.issubdtype(promoted, jnp.floating):
         promoted = jnp.float32
+    return x, y, promoted
+
+
+def _prep(x: DNDarray, y: Optional[DNDarray]):
+    x, y, promoted = _check(x, y)
+    xa, ya = x.larray, y.larray
     return x, y, xa.astype(promoted), ya.astype(promoted)
 
 
@@ -74,6 +81,62 @@ def _sq_euclidean(xa, ya):
     return jnp.maximum(x2 + y2 - 2.0 * cross, 0.0)
 
 
+def _euclid_kernel(xv, yv, dtype=None, sqrt=True):
+    """Composite cdist kernel for the fusion engine: dtype promotion, the
+    quadratic expansion, and the optional sqrt all inside one traced body so
+    a consumer (k-means' argmin) extends the same executable."""
+    xv = xv.astype(dtype)
+    yv = yv.astype(dtype)
+    d2 = _sq_euclidean(xv, yv)
+    return jnp.sqrt(d2) if sqrt else d2
+
+
+def _lazy_cdist(x: DNDarray, y: DNDarray, promoted, split, sqrt: bool):
+    """Defer the GSPMD cdist fallback as a fusion-DAG node. Returns None
+    (caller falls through to eager) when the operands decline fusion."""
+    from ..core import _operations, fusion
+
+    try:
+        nx = _operations._lazy_operand(x, x.comm)
+        ny = _operations._lazy_operand(y, x.comm)
+        res = fusion.node(_euclid_kernel, (nx, ny), dtype=jnp.dtype(promoted), sqrt=sqrt)
+    except fusion.Unfusable:
+        fusion.count_fallback()
+        return None
+    return fusion.defer(
+        res,
+        res.aval.shape,
+        types.canonical_heat_type(res.aval.dtype),
+        split,
+        x.device,
+        x.comm,
+    )
+
+
+def _pallas_eligible(x: DNDarray, y: DNDarray, promoted) -> bool:
+    from ..ops.matmul import _mode
+
+    # only when the promoted dtype is f32: the kernel accumulates and returns
+    # f32, and the GSPMD path must stay the dtype-authoritative fallback
+    return (
+        _mode() != "off"
+        and x.split == 0
+        and y.split is None
+        and jnp.dtype(promoted) == jnp.float32
+    )
+
+
+def _ring_eligible(x: DNDarray, y: DNDarray) -> bool:
+    n_dev = x.comm.size
+    return (
+        x.split == 0
+        and y.split == 0
+        and n_dev > 1
+        and x.shape[0] % n_dev == 0
+        and y.shape[0] % n_dev == 0
+    )
+
+
 def _build_rowsplit(mesh, spec, sqrt: bool):
     from ..ops.cdist import cdist as _fused
     from ..parallel.collectives import shard_map_unchecked
@@ -95,16 +158,7 @@ def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional
     a replicated small operand (distance.py:209, size-1 ring degenerate case).
     Returns None when the layout doesn't fit, to fall through to GSPMD.
     """
-    from ..ops.matmul import _mode
-
-    # only when the promoted dtype is f32: the kernel accumulates and returns
-    # f32, and the GSPMD path must stay the dtype-authoritative fallback
-    if (
-        _mode() == "off"
-        or x.split != 0
-        or y.split is not None
-        or ya.dtype != jnp.float32
-    ):
+    if not _pallas_eligible(x, y, ya.dtype):
         return None
     from ..parallel.collectives import jit_shard_map_cached
 
@@ -162,13 +216,7 @@ def _ring_cdist(x: DNDarray, y: DNDarray, xa, ya, sqrt: bool = True) -> Optional
     """
     comm = x.comm
     n_dev = comm.size
-    if (
-        x.split != 0
-        or y.split != 0
-        or n_dev <= 1
-        or x.shape[0] % n_dev
-        or y.shape[0] % n_dev
-    ):
+    if not _ring_eligible(x, y):
         return None
     from ..parallel.collectives import jit_shard_map_cached
 
@@ -189,8 +237,21 @@ def cdist(x: DNDarray, y: Optional[DNDarray] = None, quadratic_expansion: bool =
     ``quadratic_expansion`` is accepted for parity; on TPU the expansion is
     always used (it is the MXU path).  Layout dispatch: x row-split with
     small replicated y → fused Pallas kernel; both row-split → explicit
-    ``ppermute`` ring (the reference's algorithm); anything else → GSPMD."""
-    x, y, xa, ya = _prep(x, y)
+    ``ppermute`` ring (the reference's algorithm); anything else → GSPMD —
+    deferred as a fusion-DAG node when the engine is on, so a trailing
+    reduction (k-means' argmin) lands in the same executable."""
+    from ..core import fusion
+
+    x, y, promoted = _check(x, y)
+    if (
+        fusion.enabled()
+        and not _pallas_eligible(x, y, promoted)
+        and not _ring_eligible(x, y)
+    ):
+        lazy = _lazy_cdist(x, y, promoted, _result_split(x, y), sqrt=True)
+        if lazy is not None:
+            return lazy
+    xa, ya = x.larray.astype(promoted), y.larray.astype(promoted)
     fast = _pallas_rowsplit_cdist(x, y, ya, sqrt=True)
     if fast is not None:
         return fast
@@ -211,7 +272,15 @@ def rbf(
 ) -> DNDarray:
     """Gaussian (RBF) similarity matrix exp(−d²/2σ²) (reference:
     distance.py:159)."""
-    x, y, xa, ya = _prep(x, y)
+    from ..core import exponential, fusion
+
+    x, y, promoted = _check(x, y)
+    if fusion.enabled():
+        d2 = _lazy_cdist(x, y, promoted, _result_split(x, y), sqrt=False)
+        if d2 is not None:
+            # -, / and exp ride the heat ops and extend the same DAG
+            return exponential.exp(-d2 / (2.0 * sigma * sigma))
+    xa, ya = x.larray.astype(promoted), y.larray.astype(promoted)
     d2 = _sq_euclidean(xa, ya)
     s = jnp.exp(-d2 / (2.0 * sigma * sigma))
     split = _result_split(x, y)
@@ -227,3 +296,10 @@ def manhattan(x: DNDarray, y: Optional[DNDarray] = None, expand: bool = False) -
     split = _result_split(x, y)
     out = DNDarray(d, tuple(d.shape), types.canonical_heat_type(d.dtype), split, x.device, x.comm)
     return _ensure_split(out, split)
+
+
+# fusion op-table entry: the composite kernel gets a stable census name so
+# fused-chain HLO/describe() output reads "euclid_cdist" not a lambda repr
+from ..core import fusion as _fusion
+
+_fusion.register_op(_euclid_kernel, "euclid_cdist", kind="composite")

@@ -16,6 +16,8 @@
 #   6. copycheck— scripts/copycheck.py (difflib vs reference, 0.6 bar)
 #   7. notes    — every committed cb row under 30% of its roofline must
 #                 carry a note naming the bound (no silent bad scores)
+#   8. fusecache— fusion retrace guard: the second invocation of each cb
+#                 benchmark chain must be a 100% compile-cache hit
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -28,7 +30,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/7 suite (8-device mesh)"
+say "1/8 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -37,21 +39,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/7 core subset (4-device mesh)"
+say "2/8 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/7 parity audit (exits nonzero on any gap)"
+say "3/8 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/7 multi-chip dry-run"
+say "4/8 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/7 cb smoke"
+say "5/8 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -60,10 +62,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/7 copycheck"
+say "6/8 copycheck"
 python scripts/copycheck.py
 
-say "7/7 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/8 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -78,5 +80,8 @@ if bad:
     sys.exit(1)
 print("all low-roofline rows annotated")
 EOF
+
+say "8/8 fusion retrace guard (second call must hit the compile cache)"
+( cd benchmarks/cb && python fusion.py --verify-cache )
 
 say "CI GREEN"

@@ -345,3 +345,67 @@ class TestTiledTransportCensus(TestCase):
         got = a[idx]
         want = x[np.asarray(x[:, 0] > 0).nonzero()[0]]
         self.assertTrue(np.array_equal(got.numpy(), want))
+
+
+@unittest.skipIf(len(jax.devices()) < 4, "needs >= 4 devices")
+@unittest.skipIf(
+    os.environ.get("HEAT_TPU_FUSE", "").lower() in ("off", "0", "false", "no"),
+    "fusion engine disabled (HEAT_TPU_FUSE=off)",
+)
+class TestFusedChainCensus(TestCase):
+    """Structural law of the fusion engine (ISSUE 2): a 6-op
+    elementwise+reduction chain lowers to ONE executable per
+    (shape, sharding) key — first materialization is the only compile,
+    the second invocation is a 100% cache hit — and the fused numerics
+    match the eager path within dtype tolerance."""
+
+    @staticmethod
+    def _chain(x, y):
+        # 6 ops: sub, truediv, mul, add, exp, sum
+        return ht.exp((x - y) / 2.0 * x + 0.5).sum()
+
+    def _one_executable_law(self, comm):
+        from heat_tpu.core import fusion
+
+        rng = np.random.default_rng(11)
+        A = rng.standard_normal((48, 6)).astype(np.float32)
+        B = rng.standard_normal((48, 6)).astype(np.float32)
+
+        fusion.reset_cache()
+        x = ht.array(A, split=0, comm=comm)
+        y = ht.array(B, split=0, comm=comm)
+        fused = float(self._chain(x, y).larray)
+        s1 = fusion.cache_stats()
+        # the whole chain compiled exactly once: one executable, no
+        # per-op dispatches leaked out of the DAG
+        self.assertEqual(s1["misses"], 1)
+        self.assertEqual(s1["size"], 1)
+        self.assertEqual(s1["hits"], 0)
+
+        # same chain structure on fresh arrays (and a new scalar would be
+        # fine too): second invocation is a 100% cache hit
+        x2 = ht.array(A + 1.0, split=0, comm=comm)
+        y2 = ht.array(B - 1.0, split=0, comm=comm)
+        fused2 = float(self._chain(x2, y2).larray)
+        s2 = fusion.cache_stats()
+        self.assertEqual(s2["misses"], 1)
+        self.assertEqual(s2["hits"], 1)
+
+        # the compiled module really contains the trailing reduction
+        self.assertIn("reduce", fusion.last_hlo())
+
+        # numerics: fused == eager within f32 tolerance
+        with fusion.fuse(False):
+            eager = float(self._chain(x, y).larray)
+            eager2 = float(self._chain(x2, y2).larray)
+        np.testing.assert_allclose(fused, eager, rtol=1e-5)
+        np.testing.assert_allclose(fused2, eager2, rtol=1e-5)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_one_executable_mesh8(self):
+        self._one_executable_law(self.comm)
+
+    def test_one_executable_mesh4(self):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        self._one_executable_law(local_mesh(4))

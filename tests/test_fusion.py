@@ -1,0 +1,222 @@
+"""Fusion-engine behavior: lazy op chains, the compile cache, and the
+``_binary_op`` dominance rule under ``where=`` masks and mixed splits.
+
+The structural "one executable per chain" law lives in
+test_census_structural.py; this module pins the *semantics*: fused results
+must be bit-identical (up to dtype tolerance) to the eager path at mesh
+sizes 1, 4 and 8, the output split must follow the reference's dominance
+rule (first distributed operand wins, right-aligned through broadcasting),
+and the cache must be keyed on structure — not scalar values.
+"""
+
+import unittest
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from .base import TestCase
+
+
+def _mesh(n):
+    from heat_tpu.parallel.mesh import local_mesh
+
+    return local_mesh(n)
+
+
+@unittest.skipUnless(fusion.enabled(), "fusion engine disabled (HEAT_TPU_FUSE=off)")
+class TestFusionEngine(TestCase):
+    """Laziness, materialization boundaries, and the compile cache."""
+
+    def setUp(self):
+        fusion.reset_cache()
+
+    def test_chain_is_lazy_until_larray(self):
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        y = (x - 3.0) * 2.0
+        self.assertIsInstance(y, fusion.LazyDNDarray)
+        self.assertEqual(fusion.cache_stats()["misses"], 0)
+        ref = (np.arange(24, dtype=np.float32) - 3.0) * 2.0
+        self.assert_array_equal(y, ref)
+        self.assertEqual(fusion.cache_stats()["misses"], 1)
+
+    def test_second_materialization_hits_cache(self):
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        self.assert_array_equal(ht.exp(-x) + 1.0, np.exp(-np.arange(24, dtype=np.float32)) + 1.0)
+        before = fusion.cache_stats()
+        z = ht.arange(24, dtype=ht.float32, split=0)
+        _ = (ht.exp(-z) + 1.0).larray
+        after = fusion.cache_stats()
+        self.assertEqual(after["misses"], before["misses"])
+        self.assertEqual(after["hits"], before["hits"] + 1)
+
+    def test_scalar_values_do_not_retrace(self):
+        # scalars enter the program as 0-d inputs, so the fingerprint is
+        # value-independent: same chain shape with new constants = cache hit
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        _ = ((x + 1.5) * 2.0).larray
+        before = fusion.cache_stats()
+        out = ((x + 7.25) * 0.5).larray
+        after = fusion.cache_stats()
+        self.assertEqual(after["misses"], before["misses"])
+        self.assertGreater(after["hits"], before["hits"])
+        np.testing.assert_allclose(
+            np.asarray(out), (np.arange(16, dtype=np.float32) + 7.25) * 0.5, rtol=1e-6
+        )
+
+    def test_switch_off_restores_eager(self):
+        x = ht.arange(12, dtype=ht.float32, split=0)
+        with fusion.fuse(False):
+            y = x * 2.0 + 1.0
+            self.assertNotIsInstance(y, fusion.LazyDNDarray)
+        self.assert_array_equal(y, np.arange(12, dtype=np.float32) * 2.0 + 1.0)
+        # and back on: the same expression defers again
+        z = x * 2.0 + 1.0
+        self.assertIsInstance(z, fusion.LazyDNDarray)
+        self.assert_array_equal(z, np.arange(12, dtype=np.float32) * 2.0 + 1.0)
+
+    def test_bool_is_a_materialization_boundary(self):
+        x = ht.arange(1, 9, dtype=ht.float32, split=0)
+        cond = ht.all(x > 0.0)
+        self.assertTrue(bool(cond))
+        self.assertGreaterEqual(fusion.cache_stats()["misses"], 1)
+
+    def test_reduction_extends_the_chain(self):
+        x = ht.arange(32, dtype=ht.float32, split=0)
+        y = ((x - x.mean()) ** 2).sum()
+        self.assertIsInstance(y, fusion.LazyDNDarray)
+        a = np.arange(32, dtype=np.float32)
+        np.testing.assert_allclose(
+            float(y.larray), float(((a - a.mean()) ** 2).sum()), rtol=1e-5
+        )
+
+    def test_astype_joins_the_dag(self):
+        x = ht.arange(10, dtype=ht.float32, split=0)
+        y = (x + 0.6).astype(ht.int32)
+        self.assertIsInstance(y, fusion.LazyDNDarray)
+        self.assert_array_equal(y, (np.arange(10, dtype=np.float32) + 0.6).astype(np.int32))
+
+    def test_out_kwarg_stays_eager(self):
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        out = ht.zeros(8, dtype=ht.float32, split=0)
+        res = ht.add(x, 1.0, out=out)
+        self.assertIs(res, out)
+        self.assertNotIsInstance(res, fusion.LazyDNDarray)
+        self.assert_array_equal(out, np.arange(8, dtype=np.float32) + 1.0)
+
+    def test_donated_resplit_cannot_invalidate_pending_chain(self):
+        n = self.comm.size * 4
+        x = ht.arange(n, dtype=ht.float32, split=0)
+        y = x * 3.0  # pending chain pins x's buffer
+        x.resplit_(None)  # would donate x's buffer if it were safe
+        self.assert_array_equal(y, np.arange(n, dtype=np.float32) * 3.0)
+
+    def test_fallback_counter_on_mixed_meshes(self):
+        if len(jax.devices()) < 4:
+            raise unittest.SkipTest("needs a sub-mesh")
+        a = ht.arange(6, dtype=ht.float32)
+        b = ht.array(np.ones(6, dtype=np.float32), comm=_mesh(4))
+        before = fusion.cache_stats()["fallbacks"]
+        try:
+            c = a + b
+            _ = c.larray
+        except Exception:
+            pass  # eager may legitimately reject mixed meshes; the counter still moved
+        self.assertGreater(fusion.cache_stats()["fallbacks"], before)
+
+
+class _MixedSplitLaws:
+    """where= masks and mixed splits for ``_binary_op`` at one mesh size.
+
+    The dominance rule (reference heat _operations.py:90-148): a distributed
+    operand beats a replicated one; when both are split, the first operand's
+    split wins; splits map through broadcasting's right-alignment.
+    """
+
+    SHAPE = (12, 8)
+
+    def _operands(self, comm):
+        rng = np.random.default_rng(7)
+        A = rng.standard_normal(self.SHAPE).astype(np.float32)
+        B = (rng.standard_normal(self.SHAPE) + 2.0).astype(np.float32)
+        return A, B
+
+    def _dominance_cases(self):
+        # (split_a, split_b) -> expected result split
+        return [
+            ((0, 1), 0),
+            ((1, 0), 1),
+            ((0, None), 0),
+            ((None, 0), 0),
+            ((1, None), 1),
+            ((None, 1), 1),
+            ((None, None), None),
+        ]
+
+    def _mixed_split_laws(self, comm):
+        A, B = self._operands(comm)
+        for (sa, sb), want in self._dominance_cases():
+            with self.subTest(split_a=sa, split_b=sb, mesh=comm.size):
+                a = ht.array(A, split=sa, comm=comm)
+                b = ht.array(B, split=sb, comm=comm)
+                c = a * b + 1.0
+                self.assertEqual(c.split, want)
+                self.assert_array_equal(c, A * B + 1.0, rtol=1e-5, atol=1e-6)
+
+    def _broadcast_alignment_laws(self, comm):
+        A, _ = self._operands(comm)
+        v = np.linspace(1.0, 2.0, self.SHAPE[1]).astype(np.float32)
+        a0 = ht.array(A, split=0, comm=comm)
+        b0 = ht.array(v, split=0, comm=comm)  # 1-D split maps to column axis
+        with self.subTest(order="2d-first", mesh=comm.size):
+            c = a0 / b0
+            self.assertEqual(c.split, 0)
+            self.assert_array_equal(c, A / v, rtol=1e-5, atol=1e-6)
+        with self.subTest(order="1d-first", mesh=comm.size):
+            c = b0 / a0
+            self.assertEqual(c.split, 1)
+            self.assert_array_equal(c, v / A, rtol=1e-5, atol=1e-6)
+
+    def _where_mask_laws(self, comm):
+        A, B = self._operands(comm)
+        M = (A > 0.0)
+        for sa, sb, sm in [(0, None, None), (0, 1, 0), (None, 1, 1), (None, None, None)]:
+            with self.subTest(split_a=sa, split_b=sb, split_mask=sm, mesh=comm.size):
+                a = ht.array(A, split=sa, comm=comm)
+                b = ht.array(B, split=sb, comm=comm)
+                m = ht.array(M, split=sm, comm=comm)
+                fused = ht.add(a, b, where=m)
+                ref = np.where(M, A + B, np.zeros_like(A))
+                self.assert_array_equal(fused, ref, rtol=1e-5, atol=1e-6)
+                with fusion.fuse(False):
+                    eager = ht.add(a, b, where=m)
+                np.testing.assert_array_equal(fused.numpy(), eager.numpy())
+
+    def _run_all(self, comm):
+        self._mixed_split_laws(comm)
+        self._broadcast_alignment_laws(comm)
+        self._where_mask_laws(comm)
+
+
+class TestFusionMixedSplitMesh1(_MixedSplitLaws, TestCase):
+    def test_laws_mesh1(self):
+        self._run_all(_mesh(1))
+
+
+@unittest.skipIf(len(jax.devices()) < 4, "needs >= 4 devices")
+class TestFusionMixedSplitMesh4(_MixedSplitLaws, TestCase):
+    def test_laws_mesh4(self):
+        self._run_all(_mesh(4))
+
+
+@unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+class TestFusionMixedSplitMesh8(_MixedSplitLaws, TestCase):
+    def test_laws_mesh8(self):
+        self._run_all(self.comm)
+
+
+if __name__ == "__main__":
+    unittest.main()
