@@ -6,6 +6,10 @@
 #  * kmeans_step — the k-means distance-update step (cdist + argmin), the
 #    real consumer the engine was built for: fused it is ONE cached
 #    executable; eager it is a cdist program plus an argmin program.
+#  * guard_overhead — the provenance tax (ISSUE 3): the same fused chain
+#    with HEAT_TPU_GUARD on vs off.  The guard adds a site capture per op
+#    node and one isfinite-reduce program per materialization; the row
+#    measures that instead of assuming it (<5% is the acceptance bar).
 #
 # ``python fusion.py --verify-cache`` is the CI retrace guard: it runs each
 # benchmark chain twice and fails (exit 1) if the second invocation reports
@@ -16,6 +20,7 @@ import sys
 
 import heat_tpu as ht
 from heat_tpu.core import fusion as ht_fusion
+from heat_tpu.core import guard as ht_guard
 from heat_tpu.utils.monitor import record
 
 import config
@@ -82,6 +87,34 @@ def run():
              "with five N-sized temporaries. On the CPU CI mesh both are "
              "dispatch-overhead-bound, not HBM-bound — the roofline "
              "fraction is honest but the speedup column is the score.",
+    )
+
+    # guard_overhead: identical fused chain, HEAT_TPU_GUARD on vs off.
+    # The guard must host-sync the finiteness verdict at each
+    # materialization, so the fair comparison is the consuming pattern —
+    # the scalar is fetched every round in BOTH arms (the serving shape:
+    # you materialize because you need the value).  A non-consuming loop
+    # would charge the guard for lost async pipelining of results nobody
+    # reads.  Warm both states first — each compiles its own executable.
+    def run_consume(k):
+        for _ in range(k):
+            float(_chain(x, y).larray)
+
+    with ht_guard.guarded(True):
+        run_consume(1)
+        sl_on = config.slope(run_consume)
+    with ht_guard.guarded(False):
+        run_consume(1)
+        sl_off = config.slope(run_consume)
+    record(
+        "guard_overhead", sl_on.per_unit_s, per="6-op-chain",
+        n=CHAIN_N, guard_off_per_unit_s=round(sl_off.per_unit_s, 6),
+        overhead_frac=round(sl_on.per_unit_s / sl_off.per_unit_s - 1.0, 4),
+        **sl_on.fields(),
+        note="provenance tax, guard on vs off on the consumed fused "
+             "chain: per-op site capture at build + the folded/host "
+             "finiteness check per materialization. Acceptance bar is "
+             "overhead_frac < 0.05.",
     )
 
     step_k = _make_step()

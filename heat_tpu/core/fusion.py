@@ -31,11 +31,25 @@ invalidate a lazy chain built before it.
 
 ``HEAT_TPU_FUSE=off`` (or ``0``/``false``) restores fully eager execution
 for debugging; :func:`fuse` is the scoped equivalent.
+
+Guardrails (round 8, ISSUE 3): fused execution degrades instead of dying.
+A compile or execution failure of the fused program (an XLA error, a
+lowering bug) no longer propagates — :func:`_run` falls back to per-op
+eager evaluation of the same linearized DAG, and :func:`cache_stats`
+breaks the ``fallbacks`` total down by reason (``unfusable``,
+``compile_error``, ``exec_error``, ``guard_replay``).  With the
+non-finite guard on (``HEAT_TPU_GUARD``, :mod:`heat_tpu.core.guard`),
+every op node records the user source line that built it, and a
+materialized chain whose finite inputs produced NaN/Inf is replayed
+eagerly op-by-op to raise :class:`~heat_tpu.core.guard.NonFiniteError`
+naming the first offending op and its originating line.  Provenance is
+excluded from the compile-cache key, so guarding adds zero retraces.
 """
 
 from __future__ import annotations
 
 import os
+import warnings
 import weakref
 from collections import OrderedDict
 from contextlib import contextmanager
@@ -46,11 +60,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import types
+from . import guard, types
 from .dndarray import DNDarray, _physical_dim
+from .guard import NonFiniteError
 
 __all__ = [
     "LazyDNDarray",
+    "NonFiniteError",
     "Unfusable",
     "cache_stats",
     "defer",
@@ -172,17 +188,22 @@ class Expr:
     logical) and ``lshape`` its logical shape.  Op node: ``fn`` applied to
     ``args`` with static ``kwargs``; ``aval`` is the eval_shape-predicted
     result.  Materialization *leafifies* the node in place (sets ``value``,
-    drops ``fn``/``args``) so diamond DAGs never recompute a subchain."""
+    drops ``fn``/``args``) so diamond DAGs never recompute a subchain.
 
-    __slots__ = ("fn", "args", "kwargs", "aval", "value", "lshape", "__weakref__")
+    ``site`` is the user source line that built the node (guard.py
+    provenance, ``None`` with the guard off or for internal builders).  It
+    is diagnostic-only: never part of the compile-cache key."""
 
-    def __init__(self, fn, args, kwargs, aval, value=None, lshape=None):
+    __slots__ = ("fn", "args", "kwargs", "aval", "value", "lshape", "site", "__weakref__")
+
+    def __init__(self, fn, args, kwargs, aval, value=None, lshape=None, site=None):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
         self.aval = aval
         self.value = value
         self.lshape = lshape
+        self.site = site
 
     def leafify(self, value, lshape) -> None:
         self.value = value
@@ -260,10 +281,13 @@ def _infer_aval(fn, child_avals, kw_key):
 
 def node(fn: Callable, args: Tuple[Expr, ...], **kwargs) -> Expr:
     """Apply ``fn`` lazily to child nodes with static ``kwargs``.  Metadata
-    (shape/dtype) is predicted via ``jax.eval_shape`` — no execution."""
+    (shape/dtype) is predicted via ``jax.eval_shape`` — no execution.  With
+    the guard on, the user source line that built the op rides along for
+    non-finite provenance."""
     kw_key = _kwargs_key(kwargs)
     aval = _infer_aval(fn, tuple(a.aval for a in args), kw_key)
-    return Expr(fn, tuple(args), kw_key, aval)
+    site = guard.capture_site(2) if guard.enabled() else None
+    return Expr(fn, tuple(args), kw_key, aval, site=site)
 
 
 def _astype(t, dtype):
@@ -280,32 +304,46 @@ def cast_node(child: Expr, dtype) -> Expr:
     return node(_astype, (child,), dtype=jnp.dtype(dtype))
 
 
-def describe(expr: Expr) -> str:
-    """Human-readable postorder rendering of the DAG (debugging aid)."""
-    instrs, leaves, out_slot = _linearize(expr)
+def _render_instrs(instrs, leaves, out_slot, upto=None, mark=None) -> str:
+    """Shared renderer behind :func:`describe` and the guard's offending-
+    subtree report.  ``upto`` truncates after that slot; ``mark`` annotates
+    one slot (the first non-finite producer)."""
+    last = len(instrs) - 1 if upto is None else int(upto)
     lines = []
-    for i, ins in enumerate(instrs):
+    for i, ins in enumerate(instrs[: last + 1]):
         if ins[0] == "L":
             lf = leaves[ins[1]]
-            lines.append(f"%{i} = leaf{tuple(lf.lshape)}:{lf.value.dtype}")
+            line = f"%{i} = leaf{tuple(lf.lshape)}:{lf.value.dtype}"
         else:
             _, fn, kw, ch = ins
             kws = f" {dict(kw)}" if kw else ""
-            lines.append(f"%{i} = {op_name(fn)}({', '.join('%%%d' % c for c in ch)}){kws}")
-    lines.append(f"return %{out_slot}")
+            line = f"%{i} = {op_name(fn)}({', '.join('%%%d' % c for c in ch)}){kws}"
+        if mark is not None and i == mark:
+            line += "   <-- first non-finite"
+        lines.append(line)
+    lines.append(f"return %{out_slot if upto is None else last}")
     return "\n".join(lines)
+
+
+def describe(expr: Expr) -> str:
+    """Human-readable postorder rendering of the DAG (debugging aid)."""
+    instrs, _, leaves, out_slot = _linearize(expr)
+    return _render_instrs(instrs, leaves, out_slot)
 
 
 # -------------------------------------------------- fingerprint + lowering
 
 def _linearize(root: Expr):
-    """Postorder-linearize the DAG into ``(instrs, leaves, out_slot)``.
+    """Postorder-linearize the DAG into ``(instrs, sites, leaves, out_slot)``.
 
     ``instrs`` is the canonical serialization the compile cache keys on:
     leaves become ``("L", leaf_index)`` numbered by first encounter, op
     nodes ``("O", fn, kwargs_key, child_slots)``.  Shared subgraphs get one
-    slot (a diamond serializes each node once)."""
+    slot (a diamond serializes each node once).  ``sites`` is the parallel
+    per-slot provenance (guard.py user lines) — kept OUT of ``instrs`` so
+    the same chain built from two source locations shares one cache entry."""
     instrs = []
+    sites = []
     leaves = []
     slot: "dict[int, int]" = {}
     leaf_slot: "dict[tuple, int]" = {}
@@ -325,22 +363,32 @@ def _linearize(root: Expr):
                 return slot[nid]
             leaves.append(n)
             instrs.append(("L", len(leaves) - 1))
+            sites.append(n.site)
             leaf_slot[lk] = len(instrs) - 1
         else:
             ch = tuple(visit(c) for c in n.args)
             instrs.append(("O", n.fn, n.kwargs, ch))
+            sites.append(n.site)
         slot[nid] = len(instrs) - 1
         return slot[nid]
 
     out_slot = visit(root)
-    return tuple(instrs), leaves, out_slot
+    return tuple(instrs), tuple(sites), leaves, out_slot
 
 
-def _build_program(instrs, out_slot, lshapes, gshape, split, nshards, target):
+def _build_program(
+    instrs, out_slot, lshapes, gshape, split, nshards, target, with_guard=False
+):
     """The single fused computation for one cache entry: slice leaf pads to
     logical, evaluate the DAG, pad the result to its physical shape and pin
     the canonical NamedSharding — the whole `_ensure_split` finalization
-    happens *inside* the program instead of as a separate dispatch."""
+    happens *inside* the program instead of as a separate dispatch.
+
+    ``with_guard=True`` folds the non-finite guard's reduction into the
+    SAME executable: the program returns ``(out, allfinite)`` so the guard
+    costs zero extra dispatches on the hot path (a separate jitted
+    isfinite program measured ~10x the acceptable tax on the CPU CI mesh).
+    Guard-off programs are byte-identical to the unguarded build."""
 
     def program(*vals):
         env = []
@@ -355,6 +403,13 @@ def _build_program(instrs, out_slot, lshapes, gshape, split, nshards, target):
                 _, fn, kw, ch = ins
                 env.append(fn(*[env[c] for c in ch], **dict(kw or ())))
         out = env[out_slot]
+        if with_guard:
+            # on the logical (pre-pad) output: pad zeros are always finite
+            flag = (
+                jnp.all(jnp.isfinite(out))
+                if jnp.issubdtype(jnp.result_type(out), jnp.inexact)
+                else jnp.asarray(True)
+            )
         if split is not None and gshape:
             n = gshape[split]
             pn = _physical_dim(n, nshards)
@@ -362,7 +417,8 @@ def _build_program(instrs, out_slot, lshapes, gshape, split, nshards, target):
                 pad = [(0, 0)] * len(gshape)
                 pad[split] = (0, pn - n)
                 out = jnp.pad(out, pad)
-        return jax.lax.with_sharding_constraint(out, target)
+        out = jax.lax.with_sharding_constraint(out, target)
+        return (out, flag) if with_guard else out
 
     return program
 
@@ -381,15 +437,29 @@ class _Entry:
 _CACHE: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _CACHE_MAX = int(os.environ.get("HEAT_TPU_FUSE_CACHE_SIZE", "4096"))
 _STATS = {"hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0}
+# per-reason breakdown of the `fallbacks` total:
+#   unfusable     — op declined to enter the DAG (built eagerly instead)
+#   compile_error — fused program failed to trace/compile/first-run;
+#                   re-executed per-op eagerly with identical semantics
+#   exec_error    — cached executable failed at run time; same recovery
+#   guard_replay  — non-finite guard replayed the chain op-by-op to
+#                   attribute the first NaN/Inf producer
+_FALLBACK_REASONS = {
+    "unfusable": 0, "compile_error": 0, "exec_error": 0, "guard_replay": 0,
+}
 
 
 def cache_stats() -> dict:
     """Counters for the executable cache: ``hits``/``misses`` (lookups),
     ``size`` (live entries), ``evictions`` (LRU drops past
-    ``HEAT_TPU_FUSE_CACHE_SIZE``), ``fallbacks`` (ops that declined to fuse
-    and ran eagerly).  A serving steady state shows misses flat and hits
-    climbing — a miss on a repeated chain is a retrace regression."""
-    return {"size": len(_CACHE), **_STATS}
+    ``HEAT_TPU_FUSE_CACHE_SIZE``), ``fallbacks`` (total degraded-to-eager
+    events) with a per-reason breakdown under ``fallback_reasons``
+    (``unfusable`` / ``compile_error`` / ``exec_error`` /
+    ``guard_replay``).  A serving steady state shows misses flat and hits
+    climbing — a miss on a repeated chain is a retrace regression; a
+    climbing ``compile_error``/``exec_error`` bucket means fused programs
+    are failing and silently running degraded."""
+    return {"size": len(_CACHE), **_STATS, "fallback_reasons": dict(_FALLBACK_REASONS)}
 
 
 def reset_cache() -> None:
@@ -397,10 +467,13 @@ def reset_cache() -> None:
     _CACHE.clear()
     for k in _STATS:
         _STATS[k] = 0
+    for k in _FALLBACK_REASONS:
+        _FALLBACK_REASONS[k] = 0
 
 
-def count_fallback() -> None:
+def count_fallback(reason: str = "unfusable") -> None:
     _STATS["fallbacks"] += 1
+    _FALLBACK_REASONS[reason] = _FALLBACK_REASONS.get(reason, 0) + 1
 
 
 def last_hlo() -> Optional[str]:
@@ -412,9 +485,142 @@ def last_hlo() -> Optional[str]:
     return entry.jitted.lower(*entry.avals).compile().as_text()
 
 
+def _sliced_leaf(vals, lshapes, idx):
+    v = vals[idx]
+    ls = lshapes[idx]
+    if tuple(v.shape) != ls:
+        v = v[tuple(slice(0, n) for n in ls)]
+    return v
+
+
+def _eager_eval(instrs, vals, lshapes):
+    """Per-op eager evaluation of the linearized DAG: the degraded-mode
+    twin of :func:`_build_program`'s in-jit loop.  Each op dispatches as
+    its own XLA program (exactly the pre-fusion execution shape), so a
+    chain that breaks the fused compiler still computes — slower, never
+    wrong."""
+    env = []
+    for ins in instrs:
+        if ins[0] == "L":
+            env.append(_sliced_leaf(vals, lshapes, ins[1]))
+        else:
+            _, fn, kw, ch = ins
+            env.append(fn(*[env[c] for c in ch], **dict(kw or ())))
+    return env
+
+
+def _finalize_eager(out, gshape, split, nshards, target):
+    """The `_build_program` finalization (pad to physical + canonical
+    sharding) for eagerly-computed results."""
+    if split is not None and gshape:
+        n = gshape[split]
+        pn = _physical_dim(n, nshards)
+        if pn != n:
+            pad = [(0, 0)] * len(gshape)
+            pad[split] = (0, pn - n)
+            out = jnp.pad(out, pad)
+    return jax.device_put(out, target)
+
+
+def _eager_fallback(instrs, vals, lshapes, out_slot, gshape, split, comm, target):
+    env = _eager_eval(instrs, vals, lshapes)
+    return _finalize_eager(env[out_slot], tuple(gshape), split, comm.size, target)
+
+
+@jax.jit
+def _allfinite(a):
+    return jnp.all(jnp.isfinite(a))
+
+
+def _finite(v) -> bool:
+    """Host-synced finiteness of one array (True for non-float dtypes)."""
+    if not jnp.issubdtype(v.dtype, jnp.inexact):
+        return True
+    return bool(_allfinite(v))
+
+
+# Outputs at or below this many elements are guard-checked on the host (one
+# small device_get + a numpy pass); above it the allfinite reduction is
+# folded into the fused executable instead, so no output-sized host
+# transfer ever happens.  64K elements = 256 KiB of f32 — well under a
+# tile, and the host pass is cheaper than an extra XLA dispatch there.
+_GUARD_FOLD_MIN_ELEMS = 1 << 16
+
+
+def _host_finite(out) -> bool:
+    arr = np.asarray(out)
+    if not np.issubdtype(arr.dtype, np.inexact):
+        return True
+    return bool(np.isfinite(arr).all())
+
+
+def _guard_check(out, instrs, sites, leaves, lshapes, out_slot, fast_flag=None):
+    """Raise :class:`NonFiniteError` when the chain *introduced* NaN/Inf.
+
+    Fast path: the ``allfinite`` scalar the fused program already computed
+    (``fast_flag``, large outputs), or a host-side numpy pass over the
+    fetched output (small outputs / eager-fallback results).  Only when
+    that trips: if any input leaf already carried non-finite values the
+    chain merely propagated them (nansum-style workflows are legal) and
+    nothing is raised; otherwise the linearized DAG replays eagerly
+    op-by-op to name the first op whose finite inputs went non-finite."""
+    if bool(fast_flag) if fast_flag is not None else _host_finite(out):
+        return
+    vals = [lf.value for lf in leaves]
+    if not all(_finite(v) for v in vals):
+        return  # propagation, not production
+    count_fallback("guard_replay")
+    err = None
+    env = []
+    for i, ins in enumerate(instrs):
+        if ins[0] == "L":
+            env.append(_sliced_leaf(vals, lshapes, ins[1]))
+            continue
+        _, fn, kw, ch = ins
+        val = fn(*[env[c] for c in ch], **dict(kw or ()))
+        env.append(val)
+        if not _finite(val):
+            name = op_name(fn)
+            site = sites[i]
+            subtree = _render_instrs(instrs, leaves, out_slot, upto=i, mark=i)
+            err = NonFiniteError(
+                f"non-finite values first produced by op '{name}' "
+                f"(built at {guard.format_site(site)}); offending subtree:\n"
+                f"{subtree}",
+                op=name, site=site, subtree=subtree,
+            )
+            break
+    if err is None:
+        # the eager replay stayed finite: the non-finites exist only in
+        # the fused program's output (an XLA numeric divergence — or an
+        # injected corruption).  Still a guard trip: degraded numerics
+        # must not pass silently just because they resist op-level
+        # attribution.
+        subtree = _render_instrs(instrs, leaves, out_slot)
+        err = NonFiniteError(
+            "non-finite values in the fused output, but an eager op-by-op "
+            "replay of the same chain is finite — fused-program numeric "
+            "divergence (rerun with HEAT_TPU_FUSE=off to confirm); chain:\n"
+            f"{subtree}",
+            op=None, site=None, subtree=subtree,
+        )
+    if guard.strict():
+        raise err
+    # default warn mode: NumPy's own contract for sqrt(-1)/log(0)-class
+    # results is a RuntimeWarning, not an exception — keep parity, but
+    # with chain-aware attribution attached
+    warnings.warn(str(err), guard.NonFiniteWarning, stacklevel=3)
+
+
 def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
-    """Lower ``expr`` (or fetch the cached executable) and run it."""
-    instrs, leaves, out_slot = _linearize(expr)
+    """Lower ``expr`` (or fetch the cached executable) and run it.
+
+    Failure containment: a fused program that fails to compile or execute
+    falls back to per-op eager evaluation of the same DAG (counted under
+    ``compile_error``/``exec_error`` in :func:`cache_stats`); with the
+    guard on, a materialized chain whose finite inputs produced NaN/Inf
+    raises :class:`NonFiniteError` via an attributing eager replay."""
+    instrs, sites, leaves, out_slot = _linearize(expr)
     vals = [lf.value for lf in leaves]
     lshapes = tuple(tuple(lf.lshape) for lf in leaves)
     target = comm.sharding(split, len(gshape))
@@ -422,35 +628,86 @@ def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
         (tuple(v.shape), str(v.dtype), getattr(v, "sharding", None))
         for v in vals
     )
-    key = (instrs, out_slot, lshapes, sig, tuple(gshape), split, target, donate)
+    # For large outputs the guard folds its allfinite reduction into the
+    # executable (no output-sized host transfer, no extra dispatch), so
+    # the guard state is part of the program — guard-off entries stay
+    # byte-identical to the unguarded build.  Small outputs keep the
+    # unmodified program and are checked host-side after the fetch.
+    guard_on = guard.enabled()
+    fold = False
+    if guard_on:
+        n_out = 1
+        for d in gshape:
+            n_out *= int(d)
+        fold = n_out > _GUARD_FOLD_MIN_ELEMS
+    key = (
+        instrs, out_slot, lshapes, sig, tuple(gshape), split, target, donate,
+        guard_on,
+    )
+    flag = None
     entry = _CACHE.get(key)
     if entry is None:
         _STATS["misses"] += 1
-        program = _build_program(
-            instrs, out_slot, lshapes, tuple(gshape), split, comm.size, target
-        )
-        jitted = jax.jit(program, donate_argnums=donate or ())
-        # only mesh shardings are recorded for AOT re-lowering (last_hlo):
-        # a SingleDeviceSharding on an uncommitted scalar leaf would pin it
-        # to device 0 and clash with the mesh-committed array leaves
-        avals = tuple(
-            jax.ShapeDtypeStruct(
-                v.shape, v.dtype,
-                sharding=s if isinstance(s, jax.sharding.NamedSharding) else None,
+        try:
+            guard.fire("fusion.compile")
+            program = _build_program(
+                instrs, out_slot, lshapes, tuple(gshape), split, comm.size,
+                target, with_guard=fold,
             )
-            for v in vals
-            for s in (getattr(v, "sharding", None),)
-        )
-        entry = _Entry(jitted, avals)
-        _CACHE[key] = entry
-        while len(_CACHE) > _CACHE_MAX:
-            _CACHE.popitem(last=False)
-            _STATS["evictions"] += 1
+            jitted = jax.jit(program, donate_argnums=donate or ())
+            # only mesh shardings are recorded for AOT re-lowering (last_hlo):
+            # a SingleDeviceSharding on an uncommitted scalar leaf would pin it
+            # to device 0 and clash with the mesh-committed array leaves
+            avals = tuple(
+                jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=s if isinstance(s, jax.sharding.NamedSharding) else None,
+                )
+                for v in vals
+                for s in (getattr(v, "sharding", None),)
+            )
+            entry = _Entry(jitted, avals)
+            out = entry.jitted(*vals)
+            if fold:
+                out, flag = out
+        except Exception:
+            # trace/lowering/compile/first-run failure: the executable is
+            # unusable — do NOT cache it; recompute per-op eagerly
+            count_fallback("compile_error")
+            flag = None
+            out = _eager_fallback(
+                instrs, vals, lshapes, out_slot, gshape, split, comm, target
+            )
+        else:
+            _CACHE[key] = entry
+            while len(_CACHE) > _CACHE_MAX:
+                _CACHE.popitem(last=False)
+                _STATS["evictions"] += 1
     else:
         _STATS["hits"] += 1
         entry.hits += 1
         _CACHE.move_to_end(key)
-    return entry.jitted(*vals)
+        try:
+            guard.fire("fusion.exec")
+            out = entry.jitted(*vals)
+            if fold:
+                out, flag = out
+        except Exception:
+            count_fallback("exec_error")
+            flag = None
+            out = _eager_fallback(
+                instrs, vals, lshapes, out_slot, gshape, split, comm, target
+            )
+    fused_out = out
+    out = guard.corrupt("fusion.exec", out)
+    if guard_on:
+        # an injected corruption replaced the output object: the folded
+        # flag describes the pre-corruption value, so re-check explicitly
+        _guard_check(
+            out, instrs, sites, leaves, lshapes, out_slot,
+            fast_flag=flag if out is fused_out else None,
+        )
+    return out
 
 
 # ----------------------------------------------------------- lazy DNDarray

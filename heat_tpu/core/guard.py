@@ -1,0 +1,223 @@
+"""Chain-aware guardrails: non-finite provenance + fault-injection hooks.
+
+The reference Heat has no failure handling — "an MPI abort kills the job"
+(SURVEY.md §5) — and the fusion engine (core/fusion.py) sharpened the gap:
+a chain built at line A only *runs* at a materialization boundary at line
+B, so a NaN surfaces far from the op that produced it, with no indication
+which of the fused ops was at fault.  This module supplies the shared
+guardrail state:
+
+* ``HEAT_TPU_GUARD`` (default **on**, in ``warn`` mode): while enabled,
+  every lazy op node captures the *user* source line that built it (a
+  cheap ``sys._getframe`` walk that stops at the first frame outside the
+  ``heat_tpu`` package), and materialization checks the fused output for
+  NaN/Inf.  When the chain **introduced** non-finite values — the output
+  is non-finite but every input leaf was finite — the runner replays the
+  linearized DAG eagerly op-by-op and attributes the first offending op,
+  its subtree, and the originating user line.  In the default ``warn``
+  mode the attribution is emitted as a :class:`NonFiniteWarning` — the
+  chain-aware analogue of NumPy's ``RuntimeWarning: invalid value`` (the
+  reference's parity surface: ``sqrt(-1)``/``log(0)`` legitimately
+  produce non-finites and must keep doing so).  ``HEAT_TPU_GUARD=1``
+  (also ``raise``/``strict``) escalates to :class:`NonFiniteError`, the
+  ``jax.debug_nans`` idea made sharding- and chain-aware.  Chains that
+  merely *propagate* non-finite inputs (``nansum`` and friends, masking
+  workflows, Inf sentinels) never trip the guard in either mode:
+  provenance only exists for values the chain produced.
+* Fault-injection hooks (:func:`fire` / :func:`corrupt`): near-zero-cost
+  call sites that the transport engine and the fusion runner consult on
+  every attempt.  ``heat_tpu.utils.fault.install_injector`` arms them
+  with a :class:`~heat_tpu.utils.fault.FaultInjector`, so tests drive the
+  real degradation paths (OOM backoff, eager fallback, stall detection)
+  with deterministically injected faults instead of mocks.  The hooks
+  live here — not in ``utils.fault`` — so ``core``/``parallel`` modules
+  need no heavy import on their hot paths.
+
+The capture cost is a few attribute reads per op node; the check cost is
+one tiny ``isfinite``-reduce program per materialization (measured by the
+``guard_overhead`` row in benchmarks/cb/fusion.py).  Neither touches the
+fusion compile cache: provenance is deliberately excluded from the cache
+key, so two builds of the same chain from different source lines share
+one executable (asserted by scripts/ci.sh stage 9).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from contextlib import contextmanager
+from typing import Optional, Tuple
+
+__all__ = [
+    "NonFiniteError",
+    "NonFiniteWarning",
+    "capture_site",
+    "corrupt",
+    "enabled",
+    "fire",
+    "format_site",
+    "guarded",
+    "mode",
+    "set_enabled",
+    "set_mode",
+    "strict",
+]
+
+# .../heat_tpu — frames whose code lives under this prefix are library
+# internals; the first frame outside it is the user line that built the op
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MODES = ("off", "warn", "raise")
+
+
+def _env_mode() -> str:
+    raw = os.environ.get("HEAT_TPU_GUARD", "warn").strip().lower()
+    if raw in ("off", "0", "false", "no"):
+        return "off"
+    if raw in ("", "warn", "on", "default"):
+        return "warn"
+    # 1 / true / yes / raise / strict / error — any explicit escalation
+    return "raise"
+
+
+_MODE = _env_mode()
+
+
+def mode() -> str:
+    """Current guard mode: ``off`` | ``warn`` | ``raise``."""
+    return _MODE
+
+
+def _coerce(m) -> str:
+    if m is True:
+        return "raise"
+    if m is False:
+        return "off"
+    if m not in _MODES:
+        raise ValueError(f"guard mode must be one of {_MODES}, got {m!r}")
+    return m
+
+
+def set_mode(m) -> str:
+    """Set the guard mode (``off``/``warn``/``raise``; booleans coerce to
+    ``off``/``raise``).  Returns the previous mode."""
+    global _MODE
+    prev = _MODE
+    _MODE = _coerce(m)
+    return prev
+
+
+def enabled() -> bool:
+    """Whether the guard is active at all (capture + check)."""
+    return _MODE != "off"
+
+
+def strict() -> bool:
+    """Whether a guard trip raises (``raise`` mode) instead of warning."""
+    return _MODE == "raise"
+
+
+def set_enabled(flag) -> str:
+    """Boolean-flavored :func:`set_mode` (True → ``raise``, False →
+    ``off``); returns the previous mode."""
+    return set_mode(flag)
+
+
+@contextmanager
+def guarded(m=True):
+    """Scoped :func:`set_mode` (``with guard.guarded(False): ...`` or
+    ``guard.guarded("warn")``)."""
+    prev = set_mode(m)
+    try:
+        yield
+    finally:
+        set_mode(prev)
+
+
+# filename -> is-library-internal, memoized: the frame walk runs once per
+# op node, and startswith on the same handful of filenames dominates it
+_INTERNAL_FILE: dict = {}
+
+
+def capture_site(skip: int = 1) -> Optional[Tuple[str, int, str]]:
+    """``(filename, lineno, function)`` of the nearest stack frame OUTSIDE
+    the heat_tpu package — the user line that built the current op node.
+    ``None`` when every frame within the walk budget is library-internal
+    (an op built by another heat_tpu subsystem)."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:  # pragma: no cover - shallow stacks only in embeds
+        return None
+    cache = _INTERNAL_FILE
+    for _ in range(64):
+        if f is None:
+            return None
+        fname = f.f_code.co_filename
+        internal = cache.get(fname)
+        if internal is None:
+            internal = cache[fname] = fname.startswith(_PKG_ROOT)
+        if not internal:
+            return (fname, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
+def format_site(site: Optional[Tuple[str, int, str]]) -> str:
+    if site is None:
+        return "<heat_tpu internal>"
+    fname, lineno, func = site
+    return f"{fname}:{lineno} in {func}"
+
+
+class NonFiniteWarning(RuntimeWarning):
+    """Default-mode guard trip: a fused chain introduced NaN/Inf.  Carries
+    the same attribution text as :class:`NonFiniteError` — op name, user
+    source line, subtree — but follows NumPy's warning semantics
+    (``sqrt(-1)`` warns, it does not throw)."""
+
+
+class NonFiniteError(FloatingPointError):
+    """A guarded fused chain materialized NaN/Inf that its (finite) inputs
+    did not contain (raised in ``HEAT_TPU_GUARD=1``/``raise`` mode).
+
+    Attributes:
+        op: display name of the first op whose finite inputs produced a
+            non-finite output, or ``None`` when the eager replay stayed
+            finite (fused-program numeric divergence, or an injected
+            corruption of the fused output).
+        site: ``(filename, lineno, function)`` of the user line that built
+            the offending op, or ``None`` when unattributable.
+        subtree: ``fusion.describe()``-style rendering of the offending
+            op's subtree (the linearized prefix ending at the op).
+    """
+
+    def __init__(self, message: str, *, op=None, site=None, subtree=None):
+        super().__init__(message)
+        self.op = op
+        self.site = site
+        self.subtree = subtree
+
+
+# ------------------------------------------------------- injection hooks
+# Armed by heat_tpu.utils.fault.install_injector / injected(); every
+# degradation path (transport OOM backoff, fusion compile/exec fallback,
+# stall detection) consults these at its real call site, so tests inject
+# faults into production code paths instead of mocking them out.
+
+_INJECTOR = None
+
+
+def fire(site: str) -> None:
+    """Give the installed injector a chance to raise/stall at ``site``
+    (e.g. ``transport.resplit``, ``fusion.compile``).  No-op when no
+    injector is installed — the common case costs one global read."""
+    if _INJECTOR is not None:
+        _INJECTOR.fire_site(site)
+
+
+def corrupt(site: str, value):
+    """Give the installed injector a chance to corrupt ``value`` (NaN
+    injection) at ``site``.  Identity when no injector is installed."""
+    if _INJECTOR is not None:
+        return _INJECTOR.corrupt_site(site, value)
+    return value

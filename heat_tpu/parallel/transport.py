@@ -59,6 +59,7 @@ asserted at mesh 4 and 8.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import Optional, Sequence, Tuple
 
@@ -68,10 +69,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..core import guard
 from .collectives import shard_map_unchecked
 
 __all__ = [
     "TILE_BYTES",
+    "TILE_FLOOR_BYTES",
+    "reset_stats",
+    "stats",
     "tile_plan",
     "tiled_take",
     "tiled_resplit",
@@ -81,10 +86,114 @@ __all__ = [
     "rechunk_plan",
 ]
 
+
+def _env_tile_bytes(env=None) -> int:
+    raw = (os.environ if env is None else env).get("HEAT_TPU_TILE_BYTES", "").strip()
+    if not raw:
+        return 8 << 20
+    try:
+        tb = int(raw)
+        if tb <= 0:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"HEAT_TPU_TILE_BYTES must be a positive integer (bytes), got {raw!r}"
+        ) from None
+    return tb
+
+
 # Per-tile staging budget. 8 MiB keeps the per-peer all_to_all/psum_scatter
 # message ≥ 1 MiB on an 8-shard mesh (the ICI bandwidth knee) while bounding
-# the staging buffer far below any realistic local slab.
-TILE_BYTES = 8 << 20
+# the staging buffer far below any realistic local slab.  Overridable via
+# HEAT_TPU_TILE_BYTES (e.g. for memory-starved meshes or backoff testing);
+# under RESOURCE_EXHAUSTED pressure the engine halves the budget per retry
+# down to TILE_FLOOR_BYTES (see _with_oom_backoff).
+TILE_BYTES = _env_tile_bytes()
+
+# Smallest budget the OOM backoff will retry at: below 64 KiB the per-peer
+# message is latency-bound and a transfer that still OOMs is not going to
+# be saved by smaller tiles — the local slab itself no longer fits.
+TILE_FLOOR_BYTES = 64 << 10
+
+
+# ------------------------------------------------------------- OOM backoff
+
+_STATS = {
+    # successful-but-retried transfers: each halving of the budget counts 1
+    "oom_retries": 0,
+    # transfers that still hit RESOURCE_EXHAUSTED at the floor (re-raised)
+    "oom_exhausted": 0,
+    # budget the most recent tiled transfer ran (and succeeded) at
+    "last_tile_bytes": None,
+    # per-kernel retry counts: {"resplit": n, "take": n, "reshape": n}
+    "retries_by_kind": {},
+}
+
+
+def stats() -> dict:
+    """Counters for the OOM-backoff machinery: ``oom_retries`` (budget
+    halvings that led to a retry), ``oom_exhausted`` (transfers that still
+    OOMed at ``TILE_FLOOR_BYTES`` and re-raised), ``last_tile_bytes`` (the
+    budget the most recent transfer succeeded at — equal to the configured
+    ``TILE_BYTES`` unless backoff engaged), and ``retries_by_kind``."""
+    out = dict(_STATS)
+    out["retries_by_kind"] = dict(_STATS["retries_by_kind"])
+    return out
+
+
+def reset_stats() -> None:
+    """Zero the backoff counters (tests/benchmarks)."""
+    _STATS["oom_retries"] = 0
+    _STATS["oom_exhausted"] = 0
+    _STATS["last_tile_bytes"] = None
+    _STATS["retries_by_kind"] = {}
+
+
+def _is_oom(err: Exception) -> bool:
+    """Match XLA's allocation-failure surface (jaxlib raises
+    ``XlaRuntimeError`` whose message leads with RESOURCE_EXHAUSTED) plus
+    the backend variants that spell it out."""
+    msg = str(err)
+    return (
+        "RESOURCE_EXHAUSTED" in msg
+        or "Out of memory" in msg
+        or "out of memory" in msg
+    )
+
+
+def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int]):
+    """Run ``run(tile_bytes)`` with bounded OOM backoff: on a
+    RESOURCE_EXHAUSTED failure the tile budget halves and the transfer
+    retries, down to ``TILE_FLOOR_BYTES`` — a transient allocation squeeze
+    degrades throughput instead of killing the job.  Non-OOM errors
+    propagate untouched.  ``guard.fire`` lets an installed FaultInjector
+    deterministically raise/stall at each attempt (tests drive the real
+    backoff path, no mocks).
+
+    Donation caveat: a retry after a *failed donating execution* can find
+    the input buffer already consumed by XLA; injected faults fire before
+    the execution starts, and real RESOURCE_EXHAUSTED surfaces at
+    allocation time before donation commits, so in practice the input
+    survives — but a mid-execution OOM on a donated transfer is not
+    recoverable and will re-raise from the retry."""
+    tb = TILE_BYTES if tile_bytes is None else int(tile_bytes)
+    while True:
+        try:
+            guard.fire(f"transport.{kind}")
+            out = run(tb)
+        except Exception as err:  # noqa: BLE001 — filtered to OOM below
+            if not _is_oom(err):
+                raise
+            if tb <= TILE_FLOOR_BYTES:
+                _STATS["oom_exhausted"] += 1
+                raise
+            tb = max(TILE_FLOOR_BYTES, tb >> 1)
+            _STATS["oom_retries"] += 1
+            by_kind = _STATS["retries_by_kind"]
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+            continue
+        _STATS["last_tile_bytes"] = tb
+        return guard.corrupt(f"transport.{kind}", out)
 
 # Beyond this many distinct ring shifts the rechunk degenerates toward a
 # latency-bound permute chain; callers fall back to the GSPMD route.
@@ -199,34 +308,40 @@ def tiled_take(
     normalized to ``[0, n)`` — out-of-range rows would silently read
     padding.  Returns the physical output: canonical even-chunk layout with
     extent ``len(rows)`` on the split axis.  The output extent is static
-    (``rows.shape[0]``), so device-resident rows cost no host sync."""
+    (``rows.shape[0]``), so device-resident rows cost no host sync.
+    RESOURCE_EXHAUSTED retries with a halved tile budget (see
+    :func:`_with_oom_backoff`)."""
     S = int(mesh.shape[axis_name])
     n_out = int(rows.shape[0])
     per_out = -(-n_out // S) if n_out else 1
-    # staging unit = one output row replicated across the S send slots
-    tile_per, n_tiles = tile_plan(
-        per_out, S * _row_bytes(phys_vals, split), tile_bytes
-    )
-    padded = n_tiles * tile_per
-    if isinstance(rows, np.ndarray):
-        flat = np.asarray(rows, np.int32)
-        grid = np.zeros((S, padded), np.int32)
-        jj, dd = np.meshgrid(np.arange(padded), np.arange(S))
-        gidx = dd * per_out + jj
-        valid = (jj < per_out) & (gidx < n_out)
-        grid[valid] = flat[gidx[valid]]
-        rows_arg = jnp.asarray(grid.reshape(-1))
-    else:
-        flat = rows.astype(jnp.int32)
-        jj = jnp.arange(padded)[None, :]
-        gidx = jnp.arange(S)[:, None] * per_out + jj
-        valid = (jj < per_out) & (gidx < n_out)
-        grid = jnp.where(valid, flat[jnp.clip(gidx, 0, max(n_out - 1, 0))], 0)
-        rows_arg = grid.reshape(-1)
-    fn = _jit_tiled_gather(
-        mesh, axis_name, int(split), phys_vals.ndim, per_out, tile_per, n_tiles
-    )
-    return fn(phys_vals, rows_arg)
+
+    def run(tb):
+        # staging unit = one output row replicated across the S send slots
+        tile_per, n_tiles = tile_plan(
+            per_out, S * _row_bytes(phys_vals, split), tb
+        )
+        padded = n_tiles * tile_per
+        if isinstance(rows, np.ndarray):
+            flat = np.asarray(rows, np.int32)
+            grid = np.zeros((S, padded), np.int32)
+            jj, dd = np.meshgrid(np.arange(padded), np.arange(S))
+            gidx = dd * per_out + jj
+            valid = (jj < per_out) & (gidx < n_out)
+            grid[valid] = flat[gidx[valid]]
+            rows_arg = jnp.asarray(grid.reshape(-1))
+        else:
+            flat = rows.astype(jnp.int32)
+            jj = jnp.arange(padded)[None, :]
+            gidx = jnp.arange(S)[:, None] * per_out + jj
+            valid = (jj < per_out) & (gidx < n_out)
+            grid = jnp.where(valid, flat[jnp.clip(gidx, 0, max(n_out - 1, 0))], 0)
+            rows_arg = grid.reshape(-1)
+        fn = _jit_tiled_gather(
+            mesh, axis_name, int(split), phys_vals.ndim, per_out, tile_per, n_tiles
+        )
+        return fn(phys_vals, rows_arg)
+
+    return _with_oom_backoff("take", run, tile_bytes)
 
 
 # ------------------------------------------------------------------ resplit
@@ -319,7 +434,9 @@ def tiled_resplit(
     """Move ``phys`` (canonical physical layout, split ``sa``) to split
     ``sb`` through the tiled engine.  ``donate=True`` hands the input
     buffer to XLA for reuse — only pass it for buffers with no other live
-    reference (in-place ``resplit_``, stage intermediates)."""
+    reference (in-place ``resplit_``, stage intermediates).
+    RESOURCE_EXHAUSTED retries with a halved tile budget (see
+    :func:`_with_oom_backoff`)."""
     S = comm.size
     n_a, n_b = int(gshape[sa]), int(gshape[sb])
     pa = int(phys.shape[sa]) // S
@@ -329,13 +446,17 @@ def tiled_resplit(
     for d, e in enumerate(phys.shape):
         if d not in (sa, sb):
             rest *= int(e)
-    # staging unit = one destination column across (pa, S, rest)
-    tile_cols, n_tiles = tile_plan(pb, pa * S * rest * itemsize, tile_bytes)
-    fn = _jit_tiled_resplit(
-        comm.mesh, comm.split_axis, phys.ndim, int(sa), int(sb),
-        n_a, n_b, tile_cols, n_tiles, bool(donate),
-    )
-    return fn(phys)
+
+    def run(tb):
+        # staging unit = one destination column across (pa, S, rest)
+        tile_cols, n_tiles = tile_plan(pb, pa * S * rest * itemsize, tb)
+        fn = _jit_tiled_resplit(
+            comm.mesh, comm.split_axis, phys.ndim, int(sa), int(sb),
+            n_a, n_b, tile_cols, n_tiles, bool(donate),
+        )
+        return fn(phys)
+
+    return _with_oom_backoff("resplit", run, tile_bytes)
 
 
 # ------------------------------------------------------------------ reshape
@@ -539,12 +660,15 @@ def tiled_reshape(
     if plan is None:  # pragma: no cover - guarded by reshape_applicable
         raise ValueError("rechunk plan out of shift budget")
     itemsize = max(int(jnp.dtype(phys.dtype).itemsize), 1)
-    tb = TILE_BYTES if tile_bytes is None else int(tile_bytes)
-    chunk = max(1, tb // itemsize)
-    fn = _jit_rechunk(
-        comm.mesh, comm.split_axis, gin, gout, plan, chunk, mid_owned
-    )
-    phys = fn(phys)
+
+    def run_rechunk(tb, phys=phys):
+        chunk = max(1, tb // itemsize)
+        fn = _jit_rechunk(
+            comm.mesh, comm.split_axis, gin, gout, plan, chunk, mid_owned
+        )
+        return fn(phys)
+
+    phys = _with_oom_backoff("reshape", run_rechunk, tile_bytes)
 
     if so != 0:
         phys = tiled_resplit(phys, gout, 0, so, comm, donate=True,

@@ -19,7 +19,16 @@ sharded checkpoints of :mod:`heat_tpu.utils.checkpointing`:
 * :class:`FaultInjector` — deterministic fault injection for testing the
   above: raise at step N, or corrupt the loss to NaN at step N.  The test
   doctrine stays the reference's "no mocks" (SURVEY.md §4): injected faults
-  run through the real restore path on the real mesh.
+  run through the real restore path on the real mesh.  Round 8 extends it
+  below the training loop: :meth:`~FaultInjector.oom_in` /
+  :meth:`~FaultInjector.error_in` / :meth:`~FaultInjector.nan_in` /
+  :meth:`~FaultInjector.stall_in` arm *sites* inside the transport engine
+  (``transport.resplit`` / ``transport.take`` / ``transport.reshape``) and
+  the fusion runner (``fusion.compile`` / ``fusion.exec``); installing the
+  injector (:func:`install_injector` / :func:`injected`) wires it into the
+  ``heat_tpu.core.guard`` hooks those subsystems consult on every attempt,
+  so OOM backoff, eager fallback, and stall detection are all exercised by
+  faults raised at their real call sites.
 
 Multi-host note: each host runs the same supervised loop SPMD-style; a
 restore after a full-job restart resumes from the same sharded checkpoint
@@ -32,21 +41,40 @@ elasticity is restart-from-checkpoint onto the new mesh, which
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from ..core import guard
+
 __all__ = [
     "ElasticFailure",
     "FaultInjector",
+    "InjectedOOM",
     "StallDetector",
+    "clear_injector",
     "default_health_check",
+    "injected",
+    "install_injector",
     "run_elastic",
 ]
+
+
+class InjectedOOM(RuntimeError):
+    """Injected allocation failure.  The message deliberately carries the
+    ``RESOURCE_EXHAUSTED`` marker so the transport engine's OOM matcher
+    treats an injected failure exactly like a real XLA one — the backoff
+    path under test is the production path, not a test double."""
+
+    def __init__(self, site: str):
+        super().__init__(f"RESOURCE_EXHAUSTED: injected OOM at {site}")
+        self.site = site
 
 
 class ElasticFailure(RuntimeError):
@@ -63,14 +91,97 @@ class FaultInjector:
     ``nan_at`` returns the loss corrupted to NaN instead.  Each fault
     fires once ("transient") unless ``sticky=True`` ("deterministic" —
     e.g. a poisoned batch that fails on every retry).
+
+    Beyond the training loop, *site* injections target the guard hooks
+    inside transport and fusion (see the module docstring).  A site fault
+    fires on the next ``times`` hook consultations at that site and then
+    disarms; every firing is appended to :attr:`fired`, so tests assert
+    exactly what was injected where.  Arm sites, then install the injector
+    (:func:`injected` scopes the installation)::
+
+    >>> inj = FaultInjector(seed=0).oom_in("transport.resplit", times=1)
+    >>> with injected(inj):
+    ...     b = a.resplit(1)          # first tile attempt OOMs, backoff retries
+    >>> assert inj.fired == [("oom", "transport.resplit")]
     """
 
     class InjectedFault(RuntimeError):
         pass
 
-    def __init__(self):
+    def __init__(self, seed: Optional[int] = None):
+        # seed defaults from HEAT_TPU_INJECT_SEED (CI pins it) and is
+        # recorded for reproducibility bookkeeping; all injections are
+        # count-deterministic, so equal seeds + equal arming = identical
+        # fault schedules by construction.
+        if seed is None:
+            seed = int(os.environ.get("HEAT_TPU_INJECT_SEED", "0"))
+        self.seed = int(seed)
         self._raises: Dict[int, bool] = {}
         self._nans: Dict[int, bool] = {}
+        # site -> list of pending (kind, payload) faults, consumed FIFO
+        self._sites: Dict[str, List[tuple]] = {}
+        self.fired: List[tuple] = []
+
+    # ---------------------------------------------- site-level injection
+
+    def _arm(self, site: str, kind: str, payload, times: int) -> "FaultInjector":
+        queue = self._sites.setdefault(str(site), [])
+        queue.extend([(kind, payload)] * int(times))
+        return self
+
+    def oom_in(self, site: str, *, times: int = 1) -> "FaultInjector":
+        """Raise :class:`InjectedOOM` on the next ``times`` attempts at
+        ``site`` (e.g. ``transport.resplit``)."""
+        return self._arm(site, "oom", None, times)
+
+    def error_in(
+        self, site: str, *, times: int = 1, message: str = "injected failure"
+    ) -> "FaultInjector":
+        """Raise a generic ``InjectedFault`` at ``site`` — models an XLA
+        compile/lowering bug (``fusion.compile``) or runtime error
+        (``fusion.exec``)."""
+        return self._arm(site, "error", str(message), times)
+
+    def nan_in(self, site: str, *, times: int = 1) -> "FaultInjector":
+        """Corrupt the value produced at ``site`` to NaN (inexact leaves
+        only; sharding/layout preserved by in-place multiply)."""
+        return self._arm(site, "nan", None, times)
+
+    def stall_in(self, site: str, seconds: float, *, times: int = 1) -> "FaultInjector":
+        """Sleep ``seconds`` at ``site`` — a wedged collective for
+        :class:`StallDetector` to catch."""
+        return self._arm(site, "stall", float(seconds), times)
+
+    def fire_site(self, site: str) -> None:
+        """Hook target for :func:`heat_tpu.core.guard.fire`."""
+        queue = self._sites.get(site)
+        if not queue or queue[0][0] not in ("oom", "error", "stall"):
+            return
+        kind, payload = queue.pop(0)
+        self.fired.append((kind, site))
+        if kind == "oom":
+            raise InjectedOOM(site)
+        if kind == "error":
+            raise FaultInjector.InjectedFault(f"{payload} at {site}")
+        time.sleep(payload)  # stall
+
+    def corrupt_site(self, site: str, value):
+        """Hook target for :func:`heat_tpu.core.guard.corrupt`."""
+        queue = self._sites.get(site)
+        if not queue or queue[0][0] != "nan":
+            return value
+        queue.pop(0)
+        self.fired.append(("nan", site))
+
+        def poison(x):
+            dt = np.dtype(getattr(x, "dtype", np.float64))
+            if np.issubdtype(dt, np.inexact):
+                return x * dt.type(np.nan)
+            return x
+
+        return jax.tree_util.tree_map(poison, value)
+
+    # -------------------------------------------- step-level injection
 
     def raise_at(self, step: int, *, sticky: bool = False) -> "FaultInjector":
         self._raises[int(step)] = sticky
@@ -107,6 +218,13 @@ class StallDetector:
     The callback runs on the watchdog thread; it should record/alert and
     leave process teardown to the supervisor (killing a wedged XLA
     collective from inside the process is not recoverable anyway).
+
+    :meth:`pause` suspends the watchdog for work that is legitimately
+    quiet — the first compile of a large fused chain can exceed any sane
+    collective timeout.  It nests, and works standalone or scoped::
+
+    >>> with watchdog.pause():
+    ...     out = chain.materialize()   # long XLA compile, no heartbeat
     """
 
     def __init__(self, timeout: float, on_stall: Callable[[float], None]):
@@ -115,6 +233,8 @@ class StallDetector:
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._fired = False
+        self._paused = 0
+        self._pause_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "StallDetector":
@@ -132,20 +252,94 @@ class StallDetector:
         if self._thread is not None:
             self._thread.join(timeout=self.timeout + 1.0)
 
+    def pause(self) -> "_StallPause":
+        """Suspend stall detection; nests.  Returns a context manager
+        whose exit calls :meth:`resume` — or call :meth:`resume`
+        yourself for the standalone form."""
+        with self._pause_lock:
+            self._paused += 1
+        return _StallPause(self)
+
+    def resume(self) -> None:
+        """Undo one :meth:`pause`; re-arms the clock when the last pause
+        lifts, so paused time never counts as quiet time."""
+        with self._pause_lock:
+            # re-arm the clock *before* lifting the pause flag: the watch
+            # thread reads these unlocked, and must never pair a lifted
+            # flag with a stale _last from before the pause
+            self._last = time.monotonic()
+            self._fired = False
+            self._paused = max(0, self._paused - 1)
+
     def _watch(self) -> None:
         poll = min(0.05, self.timeout / 4)
         while not self._stop.wait(poll):
+            if self._paused:
+                continue
             quiet = time.monotonic() - self._last
             if quiet > self.timeout and not self._fired:
                 self._fired = True  # once per stall, not once per poll
                 self.on_stall(quiet)
 
 
+class _StallPause:
+    """Context-manager half of :meth:`StallDetector.pause` — the pause is
+    already taken when this object exists; exit releases it."""
+
+    def __init__(self, detector: StallDetector):
+        self._detector = detector
+
+    def __enter__(self) -> StallDetector:
+        return self._detector
+
+    def __exit__(self, *exc) -> bool:
+        self._detector.resume()
+        return False
+
+
+# ------------------------------------------------ injector installation
+# The guard hooks (heat_tpu.core.guard.fire/corrupt) are consulted on
+# every transport tile attempt and fused execution; installing an
+# injector arms them process-wide.
+
+
+def install_injector(injector: FaultInjector) -> FaultInjector:
+    """Arm the guard hooks with ``injector`` (process-wide)."""
+    guard._INJECTOR = injector
+    return injector
+
+
+def clear_injector() -> None:
+    """Disarm the guard hooks."""
+    guard._INJECTOR = None
+
+
+@contextmanager
+def injected(injector: FaultInjector):
+    """Scoped :func:`install_injector`::
+
+    >>> with injected(FaultInjector().oom_in("transport.resplit")):
+    ...     b = a.resplit(1)
+    """
+    prev = guard._INJECTOR
+    guard._INJECTOR = injector
+    try:
+        yield injector
+    finally:
+        guard._INJECTOR = prev
+
+
 def default_health_check(metrics: Any) -> bool:
-    """Healthy iff every array/scalar leaf of ``metrics`` is finite."""
+    """Healthy iff every array/scalar leaf of ``metrics`` is finite.
+
+    ``np.inexact`` covers real *and* complex floats —
+    ``issubdtype(complex64, floating)`` is False, and a NaN hiding in a
+    complex metric (an FFT diagnostic, say) is exactly as fatal as a real
+    one.
+    """
     for leaf in jax.tree_util.tree_leaves(metrics):
         arr = np.asarray(leaf)
-        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+        if np.issubdtype(arr.dtype, np.inexact) and not np.isfinite(arr).all():
             return False
     return True
 

@@ -240,6 +240,40 @@ class TestStallDetector(TestCase):
         finally:
             det.stop()
 
+    def test_paused_detector_never_fires_and_resumes_cleanly(self):
+        from heat_tpu.utils.fault import StallDetector
+
+        stalls = []
+        det = StallDetector(timeout=0.15, on_stall=stalls.append).start()
+        try:
+            with det.pause():  # long quiet period, e.g. first XLA compile
+                time.sleep(0.5)
+                self.assertEqual(stalls, [])  # paused: no fire despite quiet
+            # resume re-arms the clock: the paused 0.5s is not quiet time
+            time.sleep(0.05)
+            self.assertEqual(stalls, [])
+            time.sleep(0.5)  # genuinely quiet after resume -> fires again
+            self.assertEqual(len(stalls), 1)
+        finally:
+            det.stop()
+
+    def test_pause_nests(self):
+        from heat_tpu.utils.fault import StallDetector
+
+        stalls = []
+        det = StallDetector(timeout=0.15, on_stall=stalls.append).start()
+        try:
+            det.pause()
+            with det.pause():
+                time.sleep(0.3)
+            time.sleep(0.3)  # outer pause still held
+            self.assertEqual(stalls, [])
+            det.resume()
+            time.sleep(0.5)  # fully resumed -> quiet time counts again
+            self.assertEqual(len(stalls), 1)
+        finally:
+            det.stop()
+
 
 class TestFaultInjector(TestCase):
     def test_transient_fires_once(self):
@@ -256,3 +290,25 @@ class TestFaultInjector(TestCase):
         f = FaultInjector().nan_at(2, sticky=True)
         for _ in range(3):
             self.assertTrue(np.isnan(f.fire(2, np.float32(1.0))))
+
+
+class TestHealthCheck(TestCase):
+    def test_complex_nan_is_unhealthy(self):
+        # regression: issubdtype(complex64, floating) is False, so the old
+        # check passed NaN-carrying complex metrics as healthy
+        from heat_tpu.utils.fault import default_health_check
+
+        bad = {"spectrum": np.array([1 + 1j, np.nan + 0j], dtype=np.complex64)}
+        self.assertFalse(default_health_check(bad))
+        bad_imag = {"spectrum": np.array([complex(1.0, np.inf)], dtype=np.complex128)}
+        self.assertFalse(default_health_check(bad_imag))
+        good = {"spectrum": np.array([1 + 1j, 2 - 3j], dtype=np.complex64)}
+        self.assertTrue(default_health_check(good))
+
+    def test_real_and_int_leaves_unchanged(self):
+        from heat_tpu.utils.fault import default_health_check
+
+        self.assertFalse(default_health_check({"loss": np.float32(np.nan)}))
+        self.assertTrue(default_health_check({"loss": np.float32(1.0)}))
+        # integer leaves can't be non-finite; never flagged
+        self.assertTrue(default_health_check({"step": np.int64(7)}))

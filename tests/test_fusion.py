@@ -127,6 +127,32 @@ class TestFusionEngine(TestCase):
             pass  # eager may legitimately reject mixed meshes; the counter still moved
         self.assertGreater(fusion.cache_stats()["fallbacks"], before)
 
+    def _compile_failure_falls_back(self, comm):
+        """Injected compile failure -> eager values, compile_error exactly 1."""
+        from heat_tpu.utils import fault
+
+        src = np.linspace(-1.0, 1.0, comm.size * 3, dtype=np.float32)
+        ref = np.exp(src) * 2.0 - 1.0
+        fusion.reset_cache()
+        inj = fault.FaultInjector(seed=0).error_in("fusion.compile", times=1)
+        with fault.injected(inj):
+            a = ht.array(src, split=0, comm=comm)
+            got = (ht.exp(a) * 2.0 - 1.0).larray
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-7)
+        reasons = fusion.cache_stats()["fallback_reasons"]
+        self.assertEqual(reasons["compile_error"], 1)
+        self.assertEqual(inj.fired, [("error", "fusion.compile")])
+
+    def test_injected_compile_failure_mesh4(self):
+        if len(jax.devices()) < 4:
+            raise unittest.SkipTest("needs a sub-mesh")
+        self._compile_failure_falls_back(_mesh(4))
+
+    def test_injected_compile_failure_mesh8(self):
+        if len(jax.devices()) < 8:
+            raise unittest.SkipTest("needs the 8-device mesh")
+        self._compile_failure_falls_back(self.comm)
+
 
 class _MixedSplitLaws:
     """where= masks and mixed splits for ``_binary_op`` at one mesh size.

@@ -1,0 +1,273 @@
+"""Guardrail-layer behavior: non-finite provenance, fault-injected
+degradation paths, and the OOM-backoff tiling contract.
+
+Doctrine stays "no mocks" (SURVEY.md §4): every fault here is injected by
+the real :class:`~heat_tpu.utils.fault.FaultInjector` through the real
+``heat_tpu.core.guard`` hooks, so each test drives the production
+degradation path — eager fallback, tile-budget halving, guard replay — on
+the real 8-device mesh.
+"""
+
+import time
+import unittest
+import warnings
+
+import numpy as np
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core import fusion, guard
+from heat_tpu.parallel import transport
+from heat_tpu.utils import fault
+
+from .base import TestCase
+
+
+def _mesh(n):
+    from heat_tpu.parallel.mesh import local_mesh
+
+    return local_mesh(n)
+
+
+@unittest.skipUnless(fusion.enabled(), "fusion engine disabled (HEAT_TPU_FUSE=off)")
+class TestNonFiniteProvenance(TestCase):
+    """NaN introduced by a chain is attributed to op + user source line."""
+
+    def setUp(self):
+        fusion.reset_cache()
+        self._prev_guard = guard.set_enabled(True)
+
+    def tearDown(self):
+        guard.set_enabled(self._prev_guard)
+
+    def test_introduced_nan_names_op_and_user_line(self):
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        with self.assertRaises(fusion.NonFiniteError) as ctx:
+            bad = (x - x) / (x - x)  # 0/0 -> NaN, built HERE
+            build_line = bad._expr.site[1] if bad._expr.site else None
+            _ = bad.larray
+        err = ctx.exception
+        self.assertEqual(err.op, "div")
+        self.assertIsNotNone(err.site)
+        self.assertIn("test_guard.py", err.site[0])
+        self.assertEqual(err.site[1], build_line)
+        self.assertIn("div", err.subtree)
+        self.assertIn("first non-finite", err.subtree)
+        self.assertIn("test_guard.py", str(err))
+        # the attributing replay is counted as its own fallback reason
+        self.assertEqual(fusion.cache_stats()["fallback_reasons"]["guard_replay"], 1)
+
+    def test_inf_is_caught_too(self):
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        with self.assertRaises(fusion.NonFiniteError) as ctx:
+            _ = ((x + 1.0) / (x - x)).larray  # k/0 -> Inf
+        self.assertEqual(ctx.exception.op, "div")
+
+    def test_default_warn_mode_warns_with_provenance(self):
+        # the shipped default: NumPy parity (sqrt(-1)-class results come
+        # back as NaN with a warning) plus chain-aware attribution
+        with guard.guarded("warn"):
+            x = ht.arange(12, dtype=ht.float32, split=0)
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                out = np.asarray(((x - x) / (x - x)).larray)
+        self.assertTrue(np.isnan(out).all())  # values still delivered
+        msgs = [
+            str(w.message)
+            for w in caught
+            if issubclass(w.category, guard.NonFiniteWarning)
+        ]
+        self.assertEqual(len(msgs), 1)
+        self.assertIn("'div'", msgs[0])
+        self.assertIn("test_guard.py", msgs[0])
+
+    def test_guard_off_materializes_nan_silently(self):
+        with guard.guarded(False):
+            x = ht.arange(24, dtype=ht.float32, split=0)
+            out = np.asarray(((x - x) / (x - x)).larray)
+        ref = np.full(24, np.nan, dtype=np.float32)
+        np.testing.assert_array_equal(out, ref)
+        self.assertEqual(
+            fusion.cache_stats()["fallback_reasons"]["guard_replay"], 0
+        )
+
+    def test_propagated_nan_never_raises(self):
+        # non-finite INPUT flowing through a chain is legitimate
+        # (nansum/masking workflows); only values the chain *introduced*
+        # raise
+        src = np.array([1.0, np.nan, np.inf, 3.0], dtype=np.float32)
+        z = ht.array(src, split=0)
+        out = np.asarray((z * 2.0 + 1.0).larray)
+        np.testing.assert_array_equal(out, src * 2.0 + 1.0)
+
+    def test_provenance_does_not_retrace(self):
+        # sites are excluded from the compile-cache key: the same chain
+        # built from two different source lines shares one executable
+        a = ht.arange(16, dtype=ht.float32, split=0)
+        _ = ((a + 1.0) * 2.0).larray
+        stats_mid = fusion.cache_stats()
+        b = ht.arange(16, dtype=ht.float32, split=0)
+        _ = ((b + 1.0) * 2.0).larray  # different build line, same structure
+        stats_end = fusion.cache_stats()
+        self.assertEqual(stats_end["misses"], stats_mid["misses"])
+        self.assertEqual(stats_end["hits"], stats_mid["hits"] + 1)
+
+    def test_guard_toggle_matches_guard_off_values(self):
+        # guard on must not perturb finite results at all
+        x = np.linspace(-2.0, 2.0, 48, dtype=np.float32)
+        with guard.guarded(True):
+            fusion.reset_cache()
+            on = np.asarray((ht.exp(ht.array(x, split=0)) - 1.0).larray)
+        with guard.guarded(False):
+            fusion.reset_cache()
+            off = np.asarray((ht.exp(ht.array(x, split=0)) - 1.0).larray)
+        np.testing.assert_array_equal(on, off)
+
+    def test_injected_exec_corruption_is_caught_unattributed(self):
+        # NaN injected into the *fused output* (the chain itself is clean)
+        # must still raise — with op=None, because the eager replay stays
+        # finite
+        inj = fault.FaultInjector(seed=0).nan_in("fusion.exec", times=1)
+        with fault.injected(inj):
+            x = ht.arange(8, dtype=ht.float32, split=0)
+            with self.assertRaises(fusion.NonFiniteError) as ctx:
+                _ = (x + 1.0).larray
+        self.assertIsNone(ctx.exception.op)
+        self.assertEqual(inj.fired, [("nan", "fusion.exec")])
+
+
+@unittest.skipUnless(fusion.enabled(), "fusion engine disabled (HEAT_TPU_FUSE=off)")
+class TestFusionFallback(TestCase):
+    """XLA failures degrade to per-op eager execution, never propagate."""
+
+    def setUp(self):
+        fusion.reset_cache()
+
+    def test_exec_error_falls_back_to_eager(self):
+        # prime the cache so the injected failure lands on the HIT path
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        ref = np.asarray(((x + 2.0) * 0.5).larray)
+        inj = fault.FaultInjector().error_in("fusion.exec", times=1)
+        with fault.injected(inj):
+            y = ht.arange(24, dtype=ht.float32, split=0)
+            got = np.asarray(((y + 2.0) * 0.5).larray)
+        np.testing.assert_array_equal(got, ref)
+        reasons = fusion.cache_stats()["fallback_reasons"]
+        self.assertEqual(reasons["exec_error"], 1)
+        self.assertEqual(reasons["compile_error"], 0)
+
+    def test_failed_compile_does_not_poison_cache(self):
+        inj = fault.FaultInjector().error_in("fusion.compile", times=1)
+        with fault.injected(inj):
+            x = ht.arange(16, dtype=ht.float32, split=0)
+            _ = ((x * 3.0) - 1.0).larray  # falls back to eager
+        before = fusion.cache_stats()
+        self.assertEqual(before["fallback_reasons"]["compile_error"], 1)
+        # next build of the same chain compiles for real and caches
+        y = ht.arange(16, dtype=ht.float32, split=0)
+        got = np.asarray(((y * 3.0) - 1.0).larray)
+        after = fusion.cache_stats()
+        self.assertEqual(after["size"], before["size"] + 1)
+        np.testing.assert_array_equal(
+            got, np.arange(16, dtype=np.float32) * 3.0 - 1.0
+        )
+
+
+class TestTransportOOMBackoff(TestCase):
+    """RESOURCE_EXHAUSTED halves the tile budget and retries to a floor."""
+
+    def setUp(self):
+        transport.reset_stats()
+
+    def _payload(self):
+        return np.arange(16 * 24, dtype=np.float32).reshape(16, 24)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_injected_oom_on_mesh8_resplit_succeeds_after_halving(self):
+        src = self._payload()
+        ref = np.asarray(ht.array(src, split=0).resplit(1).larray)
+        transport.reset_stats()
+        inj = fault.FaultInjector(seed=0).oom_in("transport.resplit", times=1)
+        with fault.injected(inj):
+            got = np.asarray(ht.array(src, split=0).resplit(1).larray)
+        np.testing.assert_array_equal(got, ref)
+        stats = transport.stats()
+        self.assertEqual(inj.fired, [("oom", "transport.resplit")])
+        self.assertEqual(stats["oom_retries"], 1)
+        self.assertEqual(stats["retries_by_kind"], {"resplit": 1})
+        self.assertEqual(stats["last_tile_bytes"], transport.TILE_BYTES // 2)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_persistent_oom_exhausts_at_floor_and_reraises(self):
+        inj = fault.FaultInjector().oom_in("transport.resplit", times=64)
+        with self.assertRaises(fault.InjectedOOM):
+            with fault.injected(inj):
+                _ = ht.array(self._payload(), split=0).resplit(1).larray
+        stats = transport.stats()
+        self.assertEqual(stats["oom_exhausted"], 1)
+        # the budget was walked all the way down before giving up
+        halvings = stats["retries_by_kind"]["resplit"]
+        self.assertEqual(
+            max(transport.TILE_FLOOR_BYTES, transport.TILE_BYTES >> halvings),
+            transport.TILE_FLOOR_BYTES,
+        )
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_injected_oom_on_take(self):
+        src = np.arange(64, dtype=np.float32)
+        idx = np.array([3, 9, 1, 60, 33], dtype=np.int32)
+        ref = np.asarray(ht.array(src, split=0)[ht.array(idx, split=0)].larray)
+        transport.reset_stats()
+        inj = fault.FaultInjector().oom_in("transport.take", times=1)
+        with fault.injected(inj):
+            got = np.asarray(
+                ht.array(src, split=0)[ht.array(idx, split=0)].larray
+            )
+        np.testing.assert_array_equal(got, ref)
+        self.assertEqual(transport.stats()["retries_by_kind"].get("take", 0), 1)
+
+    def test_non_oom_errors_propagate_untouched(self):
+        inj = fault.FaultInjector().error_in(
+            "transport.resplit", times=1, message="not an oom"
+        )
+        with self.assertRaises(fault.FaultInjector.InjectedFault):
+            with fault.injected(inj):
+                _ = ht.array(self._payload(), split=0).resplit(1).larray
+        self.assertEqual(transport.stats()["oom_retries"], 0)
+
+    def test_tile_bytes_env_parse(self):
+        self.assertEqual(transport._env_tile_bytes({"HEAT_TPU_TILE_BYTES": "1048576"}), 1 << 20)
+        self.assertEqual(transport._env_tile_bytes({}), 8 << 20)
+        with self.assertRaises(ValueError):
+            transport._env_tile_bytes({"HEAT_TPU_TILE_BYTES": "lots"})
+        with self.assertRaises(ValueError):
+            transport._env_tile_bytes({"HEAT_TPU_TILE_BYTES": "-4"})
+
+
+class TestStallInjection(TestCase):
+    """Injected stalls at transport sites trip the real StallDetector."""
+
+    def test_injected_stall_fires_watchdog(self):
+        stalls = []
+        watchdog = fault.StallDetector(
+            timeout=0.15, on_stall=lambda quiet: stalls.append(quiet)
+        ).start()
+        try:
+            inj = fault.FaultInjector().stall_in("transport.resplit", 0.5, times=1)
+            with fault.injected(inj):
+                _ = (
+                    ht.array(
+                        np.ones((16, 24), dtype=np.float32), split=0
+                    )
+                    .resplit(1)
+                    .larray
+                )
+            deadline = time.monotonic() + 1.0
+            while not stalls and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            watchdog.stop()
+        self.assertEqual(inj.fired, [("stall", "transport.resplit")])
+        self.assertTrue(stalls, "watchdog never fired during injected stall")
+        self.assertGreater(stalls[0], 0.15)
