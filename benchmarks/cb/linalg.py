@@ -177,6 +177,57 @@ def run():
         )
     del wide
 
+    # overlap-scheduled collective matmul (parallel/overlap.py): the same
+    # sharded GEMM under both schedules, reported as a ring/gspmd ratio.
+    # Honesty note: on the CPU test mesh there is no ICI to overlap — the
+    # "transfer" is a memcpy sharing the cores the dots run on, so the ring's
+    # unrolled S-step program mostly measures dispatch overhead and ratios
+    # ≳1 are EXPECTED off-TPU; the row exists to (a) pin the dispatch and
+    # cache machinery under the benchmark harness and (b) read meaningfully
+    # on a real v5e mesh, where bytes/step rides the ring links.
+    from heat_tpu.parallel import overlap
+
+    mn = config.MATMUL_N
+
+    def _overlap_chain(a, b, out_split):
+        def run_k(k):
+            c = a
+            for _ in range(k):
+                ring = overlap.matmul(c, b, out_split=out_split)
+                # gspmd mode declines → einsum path + resplit to the same
+                # landing split (the second pass the ring schedule fuses away)
+                c = ring if ring is not None else ht.resplit(c @ b, out_split)
+            config.drain(c.larray)
+        return run_k
+
+    for row, sp_a, out_sp in (("matmul_overlap_ag", 0, 0), ("matmul_overlap_rs", 1, 1)):
+        a = ht.random.random((mn, mn), split=sp_a)
+        b = ht.random.random((mn, mn), split=0)
+        per = {}
+        for mode in ("ring", "gspmd"):
+            overlap.set_mode(mode)
+            try:
+                run_k = _overlap_chain(a, b, out_sp)
+                run_k(1)  # warmup: compile both legs
+                per[mode] = config.slope(run_k).per_unit_s
+            finally:
+                overlap.set_mode(None)
+        record(
+            row, per["ring"], per="matmul",
+            schedule="ring", gspmd_s=per["gspmd"],
+            ring_over_gspmd=per["ring"] / per["gspmd"],
+            **config.mfu_fields(
+                config.matmul_flops(mn), per["ring"],
+                config.PEAK_BF16_TFLOPS, "v5e bf16 (default matmul precision)",
+            ),
+            note="low roofline off-TPU: no ICI to overlap on a host mesh, so "
+                 "the unrolled ring pays S dispatches against a memcpy "
+                 "'transfer' — the ratio is only meaningful on real TPU "
+                 "links; rs lands the requested out-split with no resplit "
+                 "second pass",
+        )
+        del a, b
+
     ln = 50
     A = ht.random.random((ln, ln), dtype=ht.float64, split=0)
     B = A @ A.T

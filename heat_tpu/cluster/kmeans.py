@@ -24,6 +24,35 @@ from ._kcluster import _KCluster
 __all__ = ["KMeans"]
 
 
+# --- layout-API drift shims (jax>=0.6 renamed Layout→Format: arrays carry
+# --- `.format`, executables `.input_formats`; 0.4/0.5 say `.layout` and
+# --- `.input_layouts`, and the AUTO sentinel lives on Layout/DeviceLocalLayout)
+
+def _fmt_of(x):
+    """The array's device layout object (hashable on both API surfaces —
+    the AOT caches key on it)."""
+    fmt = getattr(x, "format", None)
+    return fmt if fmt is not None else x.layout
+
+
+def _auto_fmt():
+    """An ``in_shardings`` entry meaning 'let the layout solver choose'."""
+    try:
+        from jax.experimental.layout import Format, Layout
+
+        return Format(Layout.AUTO)
+    except ImportError:
+        from jax.experimental.layout import DeviceLocalLayout, Layout
+
+        return Layout(DeviceLocalLayout.AUTO)
+
+
+def _input_fmts(comp):
+    """Per-argument formats of a compiled executable."""
+    fmts = getattr(comp, "input_formats", None)
+    return fmts if fmts is not None else comp.input_layouts
+
+
 def _lloyd_while(step, centers, max_iter, tol):
     """Shared convergence driver: iterate ``step`` until ``shift² <= tol``
     or ``max_iter``, entirely on-device (``lax.while_loop``).  The
@@ -259,8 +288,6 @@ def _blocked_loop_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format):
     or a free AUTO choice that happens to differ — costs a full-array
     relayout: 12.8 GB and the OOM at the north-star size.  Re-probe
     memory_analysis() both ways whenever the body changes."""
-    from jax.experimental.layout import Format, Layout
-
     dt = jnp.dtype(dtype_str)
     x2_s = jax.ShapeDtypeStruct((rows, pf), dt)
     c_s = jax.ShapeDtypeStruct((k, pf // p), dt)
@@ -276,9 +303,9 @@ def _blocked_loop_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format):
         fn,
         in_shardings=(
             x2_format,
-            Format(Layout.AUTO),
-            Format(Layout.AUTO),
-            Format(Layout.AUTO),
+            _auto_fmt(),
+            _auto_fmt(),
+            _auto_fmt(),
         ),
     )
     return jitted.lower(x2_s, c_s, mi_s, tol_s).compile()
@@ -291,9 +318,9 @@ def _lloyd_loop_packed_blocked(x2, centers, k, p, n, blk, max_iter, tol):
     probed AUTO layout choice for it is the default row-major)."""
     comp = _blocked_loop_compiled(
         x2.shape[0], x2.shape[1], str(x2.dtype), int(k), int(p), int(n),
-        int(blk), x2.format,
+        int(blk), _fmt_of(x2),
     )
-    fmts = comp.input_formats[0]
+    fmts = _input_fmts(comp)[0]
     small = [
         jnp.asarray(centers),
         jnp.asarray(max_iter, jnp.int32),
@@ -408,7 +435,19 @@ class KMeans(_KCluster):
         arr = x.larray
         onehot = (labels[:, None] == jnp.arange(self.n_clusters)[None, :]).astype(arr.dtype)
         counts = jnp.sum(onehot, axis=0)
-        sums = jnp.matmul(onehot.T, arr)
+        sums = None
+        if x.split == 0 and x.comm.size > 1:
+            # inner-split GEMM: the sample axis is the contraction — the ring
+            # reduce-scatter schedule lands the (k, f) sums replicated without
+            # the all-gather-then-dot GSPMD would emit (decline-safe)
+            from ..parallel import overlap
+            k_ = self.n_clusters
+            sums = overlap.matmul_raw(
+                x.comm, onehot.T, arr,
+                (k_, x.shape[0]), (x.shape[0], x.shape[1]), 1, 0, None,
+            )
+        if sums is None:
+            sums = jnp.matmul(onehot.T, arr)
         old = self._cluster_centers.larray
         new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1)[:, None], old)
         return DNDarray(
@@ -647,8 +686,6 @@ def _labels_blocked_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format, with_
     relayout-copy avoidance as :func:`_blocked_loop_compiled`).  The
     inertia sweep (an extra per-block |x|^2 pass) compiles in only when
     asked — predict wants labels alone."""
-    from jax.experimental.layout import Format, Layout
-
     dt = jnp.dtype(dtype_str)
 
     def fn(x2, centers):
@@ -656,7 +693,7 @@ def _labels_blocked_compiled(rows, pf, dtype_str, k, p, n, blk, x2_format, with_
             x2, centers, p, n, blk, with_inertia
         )
 
-    jitted = jax.jit(fn, in_shardings=(x2_format, Format(Layout.AUTO)))
+    jitted = jax.jit(fn, in_shardings=(x2_format, _auto_fmt()))
     return jitted.lower(
         jax.ShapeDtypeStruct((rows, pf), dt),
         jax.ShapeDtypeStruct((k, pf // p), dt),
@@ -668,9 +705,9 @@ def _packed_labels_blocked(x2, centers, p, n, blk, with_inertia=True):
     ``with_inertia`` is off (labels-only predict path)."""
     comp = _labels_blocked_compiled(
         x2.shape[0], x2.shape[1], str(x2.dtype), int(centers.shape[0]),
-        int(p), int(n), int(blk), x2.format, bool(with_inertia),
+        int(p), int(n), int(blk), _fmt_of(x2), bool(with_inertia),
     )
-    fmts = comp.input_formats[0]
+    fmts = _input_fmts(comp)[0]
     centers = jax.device_put(jnp.asarray(centers, x2.dtype), fmts[1])
     return comp(x2, centers)
 
@@ -686,8 +723,6 @@ def _gather_rows_compiled(rows_phys, pf, dtype_str, kcount, blk, x2_format):
     blocks, a small per-block take, masked accumulate — the same
     structure as the blocked Lloyd loop, compiled with the payload's
     actual format baked in."""
-    from jax.experimental.layout import Format, Layout
-
     dt = jnp.dtype(dtype_str)
     nb = -(-rows_phys // blk)
 
@@ -707,7 +742,7 @@ def _gather_rows_compiled(rows_phys, pf, dtype_str, kcount, blk, x2_format):
             0, nb, body, jnp.zeros((kcount, pf), dt)
         )
 
-    jitted = jax.jit(fn, in_shardings=(x2_format, Format(Layout.AUTO)))
+    jitted = jax.jit(fn, in_shardings=(x2_format, _auto_fmt()))
     return jitted.lower(
         jax.ShapeDtypeStruct((rows_phys, pf), dt),
         jax.ShapeDtypeStruct((kcount,), jnp.int32),
@@ -720,9 +755,9 @@ def _gather_packed_samples(x2, idx, p: int, f: int, comm):
     blk = min(x2.shape[0], _BLOCK_ROWS)
     comp = _gather_rows_compiled(
         x2.shape[0], x2.shape[1], str(x2.dtype), int(idx.shape[0]), blk,
-        x2.format,
+        _fmt_of(x2),
     )
-    fmts = comp.input_formats[0]
+    fmts = _input_fmts(comp)[0]
     ridx = jax.device_put((idx // p).astype(jnp.int32), fmts[1])
     rows = comp(x2, ridx).reshape(idx.shape[0], p, f)
     return jnp.take_along_axis(

@@ -78,6 +78,7 @@ __all__ = [
     "node",
     "op_name",
     "register_op",
+    "register_terminator",
     "reset_cache",
     "safe_to_donate",
     "set_enabled",
@@ -423,6 +424,50 @@ def _build_program(
     return program
 
 
+# --------------------------------------------------------- chain terminators
+# Schedule-controlled engines (parallel/overlap.py's collective matmul)
+# register a *lowerer* consulted at compile-cache misses, before the generic
+# GSPMD program is built.  A lowerer that recognizes the chain returns a
+# replacement program with the same contract as _build_program
+# (``program(*leaf_vals) -> out`` or ``(out, allfinite)`` under the folded
+# guard); returning None declines.  The replacement enters the SAME cache
+# entry — hits/misses/retrace accounting in cache_stats() cover terminated
+# chains identically.  ``salt`` contributes the engine's dispatch state
+# (mode/threshold) to the cache key, so flipping HEAT_TPU_MATMUL builds a
+# distinct entry instead of reusing the other mode's executable.
+# Correctness never depends on a lowerer: a declined or failing lowering
+# falls back to the generic fused program (and a replacement program that
+# fails to compile falls back eager like any other entry).
+
+_TERMINATORS: "list[Tuple[Callable, Optional[Callable]]]" = []
+
+
+def register_terminator(lowerer: Callable, salt: Optional[Callable] = None) -> Callable:
+    """Register ``lowerer(instrs, leaves, out_slot, lshapes, gshape, split,
+    comm, target, with_guard) -> program | None`` (see block comment)."""
+    _TERMINATORS.append((lowerer, salt))
+    return lowerer
+
+
+def _terminator_salt() -> tuple:
+    return tuple(s() for _, s in _TERMINATORS if s is not None)
+
+
+def _lower_terminated(instrs, leaves, out_slot, lshapes, gshape, split, comm,
+                      target, with_guard):
+    for lowerer, _ in _TERMINATORS:
+        try:
+            program = lowerer(
+                instrs, leaves, out_slot, lshapes, gshape, split, comm,
+                target, with_guard,
+            )
+        except Exception:
+            program = None  # a broken matcher must not break the chain
+        if program is not None:
+            return program
+    return None
+
+
 # ------------------------------------------------------------ compile cache
 
 class _Entry:
@@ -642,7 +687,7 @@ def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
         fold = n_out > _GUARD_FOLD_MIN_ELEMS
     key = (
         instrs, out_slot, lshapes, sig, tuple(gshape), split, target, donate,
-        guard_on,
+        guard_on, _terminator_salt(),
     )
     flag = None
     entry = _CACHE.get(key)
@@ -650,10 +695,15 @@ def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
         _STATS["misses"] += 1
         try:
             guard.fire("fusion.compile")
-            program = _build_program(
-                instrs, out_slot, lshapes, tuple(gshape), split, comm.size,
-                target, with_guard=fold,
+            program = _lower_terminated(
+                instrs, leaves, out_slot, lshapes, tuple(gshape), split,
+                comm, target, fold,
             )
+            if program is None:
+                program = _build_program(
+                    instrs, out_slot, lshapes, tuple(gshape), split, comm.size,
+                    target, with_guard=fold,
+                )
             jitted = jax.jit(program, donate_argnums=donate or ())
             # only mesh shardings are recorded for AOT re-lowering (last_hlo):
             # a SingleDeviceSharding on an uncommitted scalar leaf would pin it

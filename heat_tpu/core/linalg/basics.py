@@ -22,9 +22,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .. import factories, sanitation, types
+from .. import _operations, factories, fusion, sanitation, types
 from ..dndarray import DNDarray, _ensure_split
 from ..stride_tricks import sanitize_axis
+from ...parallel import overlap as _overlap
 
 __all__ = [
     "cross",
@@ -62,6 +63,20 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
                 f"matmul: inner dimensions do not match: {a.shape} @ {b.shape}"
             )
     promoted = types.promote_types(a.dtype, b.dtype)
+    if a.ndim == 2 and b.ndim == 2:
+        # 2-D products route through the overlap engine: with fusion on the
+        # matmul joins the lazy DAG (consumer chains fuse into the ring
+        # epilogue, parallel/overlap.py's terminator lowers at
+        # materialization); eagerly the ring dispatcher runs directly.
+        # Either path declines back to the GSPMD einsum below.
+        split2 = 0 if a.split == 0 else (1 if b.split == 1 else None)
+        if fusion.enabled():
+            lazy = _lazy_matmul(a, b, promoted, split2)
+            if lazy is not None:
+                return lazy
+        ring = _overlap.matmul(a, b, out_split=split2)
+        if ring is not None:
+            return ring
     # astype on a matching dtype still copies under donation-less dispatch;
     # skip it so same-dtype matmuls read the operand buffers in place
     av = a.larray if a.dtype == promoted else a.larray.astype(promoted.jax_type())
@@ -87,6 +102,28 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         split, a.device, a.comm,
     )
     return _ensure_split(out, split)
+
+
+def _lazy_matmul(a: DNDarray, b: DNDarray, promoted, split):
+    """Defer ``a @ b`` as a fusion-DAG node terminated by overlap's ``_mm``.
+    Returns None (caller falls through to eager) when the operands decline
+    fusion."""
+    _overlap.ensure_registered()
+    try:
+        na = fusion.cast_node(
+            _operations._lazy_operand(a, a.comm), promoted.jax_type()
+        )
+        nb = fusion.cast_node(
+            _operations._lazy_operand(b, a.comm), promoted.jax_type()
+        )
+        res = fusion.node(_overlap._mm, (na, nb))
+    except fusion.Unfusable:
+        fusion.count_fallback()
+        return None
+    return fusion.defer(
+        res, res.aval.shape, types.canonical_heat_type(res.aval.dtype),
+        split, a.device, a.comm,
+    )
 
 
 def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
@@ -140,9 +177,13 @@ def _pp_lu_det(arr, n: int):
 
     def body(i, carry):
         A, det, sign = carry
+        # s32 indices throughout: under x64 the fori counter and argmax are
+        # s64, and the SPMD partitioner rejects their clamp-compare against
+        # the s32 shard-offset product (n always fits s32)
+        i = i.astype(jnp.int32)
         col = jax.lax.dynamic_slice_in_dim(A, i, 1, 1)[:, 0]
         cand = jnp.where(jnp.arange(n) >= i, jnp.abs(col), -jnp.inf)
-        j = jnp.argmax(cand)
+        j = jnp.argmax(cand).astype(jnp.int32)
         ri = jax.lax.dynamic_index_in_dim(A, i, 0, keepdims=False)
         rj = jax.lax.dynamic_index_in_dim(A, j, 0, keepdims=False)
         A = jax.lax.dynamic_update_index_in_dim(A, rj, i, 0)
@@ -169,9 +210,11 @@ def _gj_inv(arr, n: int):
     aug = jnp.concatenate([arr, jnp.eye(n, dtype=arr.dtype)], axis=1)
 
     def body(i, aug):
+        # s32 indices for the same partitioner-compare reason as _pp_lu_det
+        i = i.astype(jnp.int32)
         col = jax.lax.dynamic_slice_in_dim(aug, i, 1, 1)[:, 0]
         cand = jnp.where(jnp.arange(n) >= i, jnp.abs(col), -jnp.inf)
-        j = jnp.argmax(cand)
+        j = jnp.argmax(cand).astype(jnp.int32)
         ri = jax.lax.dynamic_index_in_dim(aug, i, 0, keepdims=False)
         rj = jax.lax.dynamic_index_in_dim(aug, j, 0, keepdims=False)
         aug = jax.lax.dynamic_update_index_in_dim(aug, rj, i, 0)

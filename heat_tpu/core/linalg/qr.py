@@ -48,10 +48,24 @@ def orthogonality_defect(q: DNDarray) -> DNDarray:
     factorization should be re-run with Householder (the replicated
     ``jnp.linalg.qr`` route) or in f64."""
     sanitation.sanitize_in(q)
-    arr = q.larray
-    gram = jnp.matmul(
-        arr.T, arr, precision=jax.lax.Precision.HIGHEST
-    )
+    gram = None
+    if q.split == 0 and q.ndim == 2 and q.comm.size > 1:
+        # the split axis is the contraction: ride the overlap engine's
+        # reduce-scatter ring (out replicated) so the partial Gram transfer
+        # overlaps each step's local dot; physical transpose keeps the
+        # k-pads consistent (the rs kernel masks them).  Decline-safe.
+        from ...parallel import overlap
+
+        m, n = q.shape
+        gram = overlap.matmul_raw(
+            q.comm, q.parray.T, q.parray, (n, m), (m, n), 1, 0, None,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    if gram is None:
+        arr = q.larray
+        gram = jnp.matmul(
+            arr.T, arr, precision=jax.lax.Precision.HIGHEST
+        )
     defect = jnp.max(jnp.abs(gram - jnp.eye(gram.shape[0], dtype=gram.dtype)))
     return DNDarray(
         defect, (), types.canonical_heat_type(defect.dtype),

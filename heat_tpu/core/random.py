@@ -162,7 +162,11 @@ def _chunk_sampler(sampler, shape, jdtype):
         if rem:
             kb = jax.random.fold_in(key, n_full)
             blk = sampler(kb, (rem,) + tail, _dtype, *params)
-            out = jax.lax.dynamic_update_slice(out, blk, (n_full * rows,) + zeros)
+            # s32 indices: under x64 a python-int start index lowers to an s64
+            # constant, and the SPMD partitioner rejects its clamp-compare
+            # against the s32 local-shape product
+            idx = tuple(jnp.int32(v) for v in (n_full * rows,) + zeros)
+            out = jax.lax.dynamic_update_slice(out, blk, idx)
         return out
 
     return chunked

@@ -11,7 +11,23 @@ functional but jax keeps in numpy, e.g. ``max_pool`` equivalents live in
 import flax.linen as _linen
 import jax.nn as _jnn
 
-__all__ = ["func_getattr"]
+__all__ = ["func_getattr", "linear"]
+
+
+def linear(input, weight, bias=None):
+    """``input @ weight.T + bias`` (torch's ``F.linear`` convention:
+    ``weight`` is (out_features, in_features)).
+
+    Routed through the heat ops rather than raw jnp so the fusion engine
+    captures the chain: with the engine on, the matmul terminates a lazy
+    chain and the bias add rides into the ring program as a fused epilogue
+    (heat_tpu/parallel/overlap.py) instead of a second sharded pass."""
+    from ..core.linalg import basics
+
+    out = basics.matmul(input, basics.transpose(weight))
+    if bias is not None:
+        out = out + bias
+    return out
 
 
 def func_getattr(name):

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .matmul import tpu_compiler_params
+
 from .matmul import _mode
 
 __all__ = ["flash_attention"]
@@ -125,7 +127,7 @@ def _flash_pallas(q, k, v, causal, scale, block_q=512, block_k=2048, interpret=F
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

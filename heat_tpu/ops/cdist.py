@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .matmul import tpu_compiler_params
+
 from .matmul import _mode, _pad_to
 
 __all__ = ["cdist"]
@@ -74,7 +76,7 @@ def _cdist_pallas(x, y, sqrt=True, block=256, interpret=False):
             pltpu.VMEM((bm, 1), jnp.float32),
             pltpu.VMEM((1, bn), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -26,6 +26,14 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["matmul"]
 
 
+def tpu_compiler_params(**kwargs):
+    """Pallas TPU compiler params across the API drift: the class is
+    ``CompilerParams`` on jax>=0.6.1 but ``TPUCompilerParams`` before —
+    the version-dispatch twin of ``collectives.shard_map_unchecked``."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def _mode() -> str:
     forced = os.environ.get("HEAT_TPU_PALLAS", "")
     if forced in ("interpret", "tpu", "off"):
@@ -78,7 +86,7 @@ def _mm_pallas(a, b, block_m=512, block_n=512, block_k=512, interpret=False):
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((mp, np_), a.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -22,6 +22,7 @@ from .mesh import (
     hybrid_mesh,
 )
 from . import collectives
+from . import overlap
 from . import pipeline
 from .pipeline import pipeline_apply, stack_stage_params
 from . import expert
@@ -38,6 +39,7 @@ __all__ = [
     "init_distributed",
     "hybrid_mesh",
     "collectives",
+    "overlap",
     "pipeline",
     "pipeline_apply",
     "stack_stage_params",

@@ -1,0 +1,761 @@
+"""Overlap-scheduled collective matmul: ring-decomposed GEMM with fused epilogues.
+
+The reference's ``matmul`` (heat/core/linalg/basics.py:424) is a ~700-line
+hand-scheduled block ring because overlapping tile communication with the
+local GEMM is where distributed matmul performance lives.  The rebuild's
+default is the opposite extreme — one einsum under GSPMD
+(core/linalg/basics.py) — which serializes the collective against the
+compute and, when the convention out-split disagrees with XLA's chosen
+layout, pays a second full-array resplit (``_ensure_split``).
+
+This module is the middle path (Wang et al., ASPLOS 2023: decompose the
+collective matmul so each transferred tile overlaps the previous tile's
+dot).  The three canonical sharded 2-D GEMM cases lower to per-step
+shard_map programs whose ring transfers (``ring_shift`` — one
+collective-permute riding the ICI torus links) are issued *before* the
+step's local dot, so XLA's async collectives run the wire and the MXU
+concurrently:
+
+``ag``   A row-split  ×  B row-split  →  out row-split.
+         Stationary A row-block; B's k-blocks rotate.  The all-gather of B
+         that GSPMD would materialize is unrolled into the ring and the
+         replicated copy never exists.
+``rs``   A col-split  ×  B row-split  (inner-dim split)  →  out row-split,
+         col-split or replicated — the caller's choice.  The *accumulator*
+         travels: each hop carries a partial out-block one neighbor further
+         while the next partial dot computes, a reduce-scatter unrolled
+         into the ring that lands directly in the requested out-split (no
+         ``_ensure_split`` second pass, no full-size psum buffer).
+``col``  A col-split  ×  B col-split  →  out col-split.
+         Stationary B col-block; A's k-blocks rotate (symmetric to ``ag``).
+
+Every program carries an optional fused epilogue — ``scale``/``bias``/
+``activation``/``cast`` via :class:`Epilogue` for eager calls, or an
+arbitrary elementwise tail captured from the fusion DAG (``core/fusion.py``
+chains ending in matmul lower here through the registered chain
+terminator) — applied to the final local block inside the same executable.
+Epilogue constants enter as runtime operands, so new values never retrace.
+
+Dispatch: ``HEAT_TPU_MATMUL=auto|gspmd|ring`` (auto picks the ring above
+``HEAT_TPU_MATMUL_RING_MIN_BYTES`` moved per ring step, GSPMD for
+tiny/replicated operands).  Eager programs are cached via
+``jit_shard_map_cached``; lazy chains live in the fusion compile cache
+(one entry per chain × dispatch mode).  :func:`stats` reports the schedule
+decisions, steps, bytes/step and cache hits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .collectives import (
+    all_gather,
+    jit_shard_map_cached,
+    ring_shift,
+    shard_map_unchecked,
+)
+
+__all__ = [
+    "Epilogue",
+    "matmul",
+    "matmul_raw",
+    "ring_sweep",
+    "stats",
+    "reset_stats",
+    "set_mode",
+]
+
+
+# ------------------------------------------------------------------ dispatch
+
+_VALID_MODES = ("auto", "gspmd", "ring")
+_RING_MIN_BYTES_DEFAULT = 1 << 20  # 1 MiB moved over the ring
+_MODE_OVERRIDE: Optional[str] = None
+
+
+def set_mode(mode: Optional[str]) -> Optional[str]:
+    """Process-wide override of ``HEAT_TPU_MATMUL`` (``None`` restores the
+    environment variable).  Returns the previous override."""
+    global _MODE_OVERRIDE
+    if mode is not None and mode not in _VALID_MODES:
+        raise ValueError(f"mode must be one of {_VALID_MODES}, got {mode!r}")
+    prev = _MODE_OVERRIDE
+    _MODE_OVERRIDE = mode
+    return prev
+
+
+def _mode() -> str:
+    if _MODE_OVERRIDE is not None:
+        return _MODE_OVERRIDE
+    raw = os.environ.get("HEAT_TPU_MATMUL", "auto").strip().lower()
+    return raw if raw in _VALID_MODES else "auto"
+
+
+def _ring_min_bytes() -> int:
+    raw = os.environ.get("HEAT_TPU_MATMUL_RING_MIN_BYTES", "")
+    try:
+        return int(raw) if raw else _RING_MIN_BYTES_DEFAULT
+    except ValueError:
+        return _RING_MIN_BYTES_DEFAULT
+
+
+def _dispatch_salt() -> tuple:
+    # participates in the fusion compile-cache key: flipping the mode or
+    # threshold must build a distinct entry, not reuse the other mode's
+    return ("overlap", _mode(), _ring_min_bytes())
+
+
+def _ceil_mult(n: int, s: int) -> int:
+    return -(-n // s) * s
+
+
+def _classify(a_split: Optional[int], b_split: Optional[int]) -> Optional[str]:
+    if a_split == 0 and b_split == 0:
+        return "ag"
+    if a_split == 1 and b_split == 0:
+        return "rs"
+    if a_split == 1 and b_split == 1:
+        return "col"
+    return None
+
+
+def _decide(case, out_split, m, k, n, S, comp_isz, acc_isz):
+    """Schedule decision: ``(use_ring, reason, bytes_per_step)``.
+
+    bytes/step is the per-device ICI traffic of one ring hop — the moving
+    operand block (``ag``/``col``) or the traveling accumulator (``rs``).
+    ``auto`` rings only when total wire traffic clears the threshold: below
+    it the per-step dispatch overhead beats any overlap win and GSPMD's
+    single fused collective is faster."""
+    if case is None:
+        return False, "layout", 0
+    if S <= 1:
+        return False, "mesh1", 0
+    if case == "ag" and out_split != 0:
+        return False, "out-split", 0
+    if case == "col" and out_split != 1:
+        return False, "out-split", 0
+    if case == "ag":
+        bps = (_ceil_mult(k, S) // S) * n * comp_isz
+    elif case == "col":
+        bps = m * (_ceil_mult(k, S) // S) * comp_isz
+    elif out_split == 1:
+        bps = m * (_ceil_mult(n, S) // S) * acc_isz
+    else:
+        bps = (_ceil_mult(m, S) // S) * n * acc_isz
+    mode = _mode()
+    if mode == "gspmd":
+        return False, "mode=gspmd", bps
+    if mode == "ring":
+        return True, "mode=ring", bps
+    if bps * (S - 1) < _ring_min_bytes():
+        return False, "below-threshold", bps
+    return True, "auto", bps
+
+
+# --------------------------------------------------------------------- stats
+
+_STATS = {
+    "calls": 0,
+    "ring_calls": 0,
+    "gspmd_calls": 0,
+    "ring_builds": 0,
+    "cache_hits": 0,
+    "by_schedule": {"ring_ag": 0, "ring_rs": 0, "ring_col": 0, "gspmd": 0},
+    "last": None,
+}
+_SEEN: set = set()
+
+
+def stats() -> dict:
+    """Dispatcher counters: ``calls`` (decisions), ``ring_calls`` /
+    ``gspmd_calls``, ``ring_builds`` (programs built), ``cache_hits``
+    (eager ring calls served by an already-built program; lazy-chain reuse
+    is counted by ``fusion.cache_stats()`` instead), ``by_schedule``, and
+    ``last`` — the most recent decision's schedule, steps, bytes/step,
+    out-split and reason."""
+    out = dict(_STATS)
+    out["by_schedule"] = dict(_STATS["by_schedule"])
+    out["last"] = dict(_STATS["last"]) if _STATS["last"] else None
+    return out
+
+
+def reset_stats() -> None:
+    _STATS.update(
+        calls=0, ring_calls=0, gspmd_calls=0, ring_builds=0, cache_hits=0,
+        last=None,
+    )
+    for key in _STATS["by_schedule"]:
+        _STATS["by_schedule"][key] = 0
+    _SEEN.clear()
+
+
+def _record(schedule, *, steps=0, bps=0, out_split=None, reason="",
+            cache_hit=False):
+    _STATS["calls"] += 1
+    if schedule == "gspmd":
+        _STATS["gspmd_calls"] += 1
+    else:
+        _STATS["ring_calls"] += 1
+        if cache_hit:
+            _STATS["cache_hits"] += 1
+        else:
+            _STATS["ring_builds"] += 1
+    _STATS["by_schedule"][schedule] += 1
+    _STATS["last"] = {
+        "schedule": schedule, "steps": steps, "bytes_per_step": bps,
+        "out_split": out_split, "reason": reason,
+    }
+
+
+# ---------------------------------------------------------------- ring sweep
+
+def ring_sweep(axis: str, n_steps: int, moving, state, step: Callable):
+    """Unrolled ring schedule: ``state = step(t, moving_t, state)`` for each
+    of ``n_steps`` ring positions, with the next hop's ``ring_shift`` issued
+    *before* the step's compute so XLA overlaps the transfer of block t+1
+    with the local work on block t.  Unrolling (python range, not
+    fori_loop) is what makes the overlap possible — a loop iteration is a
+    scheduling barrier, an unrolled chain is not.  The final useless shift
+    is elided."""
+    for t in range(n_steps):
+        nxt = ring_shift(moving, axis, shift=1) if t + 1 < n_steps else None
+        state = step(t, moving, state)
+        moving = nxt
+    return state
+
+
+# ----------------------------------------------------------------- epilogue
+
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def _apply_steps(blk, steps, extras):
+    for fn, kw, pat in steps:
+        blk = fn(*[blk if p < 0 else extras[p] for p in pat], **dict(kw))
+    return blk
+
+
+@dataclasses.dataclass(frozen=True)
+class Epilogue:
+    """Fused matmul tail for eager calls, applied to each final local block
+    inside the ring program: ``out = cast(act(scale * (a @ b) + bias))``
+    (``None`` fields are skipped).  ``bias`` broadcasts against the 2-D
+    result; ``activation`` must be a traceable elementwise callable (e.g.
+    ``jax.nn.relu``; use a module-level function — a fresh lambda per call
+    defeats the program cache).  ``scale``/``bias`` enter the program as
+    runtime operands: new constants never retrace."""
+
+    scale: Any = None
+    bias: Any = None
+    activation: Optional[Callable] = None
+    dtype: Any = None
+
+    def lower(self):
+        """→ ``(steps, extras)`` in the engine's internal encoding: each
+        step is ``(fn, static_kwargs_items, arg_pattern)`` with ``-1`` in
+        the pattern marking the flowing block and ``i ≥ 0`` an extras
+        operand."""
+        steps, extras = [], []
+        if self.scale is not None:
+            extras.append(jnp.asarray(self.scale))
+            steps.append((jnp.multiply, (), (-1, len(extras) - 1)))
+        if self.bias is not None:
+            extras.append(jnp.asarray(self.bias))
+            steps.append((jnp.add, (), (-1, len(extras) - 1)))
+        if self.activation is not None:
+            steps.append((self.activation, (), (-1,)))
+        if self.dtype is not None:
+            steps.append((_cast, (("dtype", jnp.dtype(self.dtype)),), (-1,)))
+        return tuple(steps), tuple(extras)
+
+
+def _extra_axes(extra_shapes, gshape, out_split) -> tuple:
+    """Per-extra axis that tracks the out-split (kernel slices it per
+    block), or None when the extra broadcasts along the split dim."""
+    axes = []
+    for es in extra_shapes:
+        eax = None
+        if out_split is not None and es:
+            ax = out_split - (len(gshape) - len(es))
+            if 0 <= ax < len(es) and es[ax] == gshape[out_split] and es[ax] > 1:
+                eax = ax
+        axes.append(eax)
+    return tuple(axes)
+
+
+# ------------------------------------------------------------- ring kernels
+
+class _Spec(NamedTuple):
+    """Hashable program identity for ``jit_shard_map_cached`` / the fusion
+    compile cache.  Epilogue ``steps`` carry function objects (hashable);
+    extra *values* stay out — they are runtime operands."""
+
+    case: str
+    out_split: Optional[int]
+    axis: str
+    S: int
+    m: int
+    k: int
+    n: int
+    comp_dt: str     # dtype both operands are cast to (the promoted dtype)
+    acc_dt: str      # dot accumulator (f32 for half inputs)
+    steps: tuple
+    extra_axes: tuple
+    prec: Any
+    fold: bool       # return (block, allfinite) for the folded guard
+
+
+def _build_ring(mesh, spec: _Spec):
+    """One shard_map program for one :class:`_Spec` (un-jitted; callers jit
+    it — directly for eager entries, traced into the fused chain program
+    for the terminator path)."""
+    case, out_split, axis, S = spec.case, spec.out_split, spec.axis, spec.S
+    m, k, n = spec.m, spec.k, spec.n
+    comp = jnp.dtype(spec.comp_dt)
+    acc_dt = jnp.dtype(spec.acc_dt)
+    kp, mp, np_ = _ceil_mult(k, S), _ceil_mult(m, S), _ceil_mult(n, S)
+    kb, mb, nb = kp // S, mp // S, np_ // S
+
+    def _dot(x, y):
+        return lax.dot_general(
+            x, y, (((1,), (0,)), ((), ())),
+            precision=spec.prec, preferred_element_type=acc_dt,
+        )
+
+    # the k-pad region of a physical operand is not guaranteed zero (a
+    # donated or transported buffer may carry garbage, even NaN — and
+    # NaN·0 would still poison the dot), so both operands' k-pads are
+    # masked to exact zeros before any block enters the ring
+    def _mask_k(v, me, axis_in_v):
+        gidx = me * kb + jnp.arange(kb, dtype=jnp.int32)
+        keep = gidx < k
+        keep = keep[:, None] if axis_in_v == 0 else keep[None, :]
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    def _finish(blk, extras, me):
+        blk = blk.astype(comp)
+        blk_sz = mb if out_split == 0 else nb
+        ex = []
+        for v, eax in zip(extras, spec.extra_axes):
+            if eax is not None:
+                ext = v.shape[eax]
+                pad = blk_sz * S - ext
+                if pad:
+                    v = jnp.pad(
+                        v, [(0, pad) if i == eax else (0, 0) for i in range(v.ndim)]
+                    )
+                v = lax.dynamic_slice_in_dim(v, me * blk_sz, blk_sz, axis=eax)
+            ex.append(v)
+        blk = _apply_steps(blk, spec.steps, ex)
+        # re-zero the out-split pad rows/cols: they hold garbage from the
+        # operand pads (and the epilogue's bias would otherwise leak into
+        # them), and the physical-layout contract is zero pad
+        if out_split == 0 and mp != m:
+            rows = me * mb + jnp.arange(mb, dtype=jnp.int32)
+            blk = jnp.where((rows < m)[:, None], blk, jnp.zeros((), blk.dtype))
+        elif out_split == 1 and np_ != n:
+            cols = me * nb + jnp.arange(nb, dtype=jnp.int32)
+            blk = jnp.where((cols < n)[None, :], blk, jnp.zeros((), blk.dtype))
+        if not spec.fold:
+            return blk
+        ok = (
+            jnp.all(jnp.isfinite(blk))
+            if jnp.issubdtype(blk.dtype, jnp.inexact)
+            else jnp.asarray(True)
+        )
+        return blk, lax.pmin(ok.astype(jnp.int32), axis)
+
+    if case == "ag":
+        # stationary A row-block needs every k-block of B: rotate them
+        def kernel(a_loc, b_loc, *extras):
+            me = lax.axis_index(axis)
+            av = a_loc.astype(comp)                      # (mb, k)
+            bv = b_loc.astype(comp)                      # (kb, n)
+            if kp != k:
+                bv = _mask_k(bv, me, 0)
+                av = jnp.pad(av, ((0, 0), (0, kp - k)))
+
+            def step(t, moving, acc):
+                src = (me - t) % S
+                a_blk = lax.dynamic_slice_in_dim(av, src * kb, kb, axis=1)
+                return acc + _dot(a_blk, moving)
+
+            acc = ring_sweep(axis, S, bv, jnp.zeros((mb, n), acc_dt), step)
+            return _finish(acc, extras, me)
+
+        in_op = (P(axis, None), P(axis, None))
+        out_spec = P(axis, None)
+
+    elif case == "col":
+        # stationary B col-block needs every k-block of A: rotate them
+        def kernel(a_loc, b_loc, *extras):
+            me = lax.axis_index(axis)
+            av = a_loc.astype(comp)                      # (m, kb)
+            bv = b_loc.astype(comp)                      # (k, nb)
+            if kp != k:
+                av = _mask_k(av, me, 1)
+                bv = jnp.pad(bv, ((0, kp - k), (0, 0)))
+
+            def step(t, moving, acc):
+                src = (me - t) % S
+                b_blk = lax.dynamic_slice_in_dim(bv, src * kb, kb, axis=0)
+                return acc + _dot(moving, b_blk)
+
+            acc = ring_sweep(axis, S, av, jnp.zeros((m, nb), acc_dt), step)
+            return _finish(acc, extras, me)
+
+        in_op = (P(None, axis), P(None, axis))
+        out_spec = P(None, axis)
+
+    else:  # rs: inner-dim split, traveling accumulator
+        eff = 1 if out_split == 1 else 0
+
+        def kernel(a_loc, b_loc, *extras):
+            me = lax.axis_index(axis)
+            av = a_loc.astype(comp)                      # (m, kb)
+            bv = b_loc.astype(comp)                      # (kb, n)
+            if kp != k:
+                av = _mask_k(av, me, 1)
+                bv = _mask_k(bv, me, 0)
+            if eff == 0:
+                ap = jnp.pad(av, ((0, mp - m), (0, 0))) if mp != m else av
+
+                def partial_(d):
+                    blk = lax.dynamic_slice_in_dim(ap, d * mb, mb, axis=0)
+                    return _dot(blk, bv)
+            else:
+                bp = jnp.pad(bv, ((0, 0), (0, np_ - n))) if np_ != n else bv
+
+                def partial_(d):
+                    blk = lax.dynamic_slice_in_dim(bp, d * nb, nb, axis=1)
+                    return _dot(av, blk)
+
+            # the partial sum itself rides the ring: shard r starts the
+            # accumulator destined for r-1 and hops it one neighbor up per
+            # step while the next local partial dot — independent of the
+            # in-flight transfer — computes.  After S-1 hops every
+            # accumulator reaches its destination with all S contributions:
+            # a reduce-scatter unrolled into the ring.
+            acc = partial_((me - 1) % S)
+            for t in range(1, S):
+                sent = ring_shift(acc, axis, shift=1)
+                acc = sent + partial_((me - t - 1) % S)
+            if out_split is None:
+                full = all_gather(acc, axis, concat_axis=0, tiled=True)
+                return _finish(full[:m], extras, me)
+            return _finish(acc, extras, me)
+
+        in_op = (P(None, axis), P(axis, None))
+        out_spec = (
+            P() if out_split is None
+            else P(axis, None) if out_split == 0
+            else P(None, axis)
+        )
+
+    in_specs = in_op + (P(),) * len(spec.extra_axes)
+    out_specs = (out_spec, P()) if spec.fold else out_spec
+    return shard_map_unchecked(kernel, mesh, in_specs, out_specs)
+
+
+# --------------------------------------------------------------- eager entry
+
+def _pad_physical(v, lshape, split, S):
+    """Ensure ``v`` carries the even-chunk physical layout along ``split``
+    (zero-padding a logical array; rejecting unexpected layouts)."""
+    want = _ceil_mult(lshape[split], S)
+    have = v.shape[split]
+    if have == want:
+        return v
+    if have != lshape[split]:
+        raise ValueError(
+            f"operand dim {split} is {have}, neither logical "
+            f"{lshape[split]} nor physical {want}"
+        )
+    pad = [(0, 0)] * v.ndim
+    pad[split] = (0, want - have)
+    return jnp.pad(v, pad)
+
+
+def _spec_for(comm, case, out_split, m, k, n, comp, steps, extra_axes,
+              precision, fold):
+    comp = jnp.dtype(comp)
+    half = jnp.issubdtype(comp, jnp.inexact) and comp.itemsize < 4
+    acc = jnp.dtype(jnp.float32) if half else comp
+    return _Spec(
+        case, out_split, comm.split_axis, comm.size, m, k, n,
+        str(comp), str(acc), steps, extra_axes, precision, fold,
+    )
+
+
+def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
+               out_split=None, *, comp_dtype=None, epilogue: Optional[Epilogue] = None,
+               precision=None):
+    """Raw-array eager entry (the DNDarray-free engine core, for callers
+    like ``linalg.qr`` and ``cluster.kmeans`` that hold jax arrays):
+    dispatches one 2-D sharded GEMM, returning the physical result array —
+    or ``None`` when the dispatcher picks GSPMD and the caller should run
+    its own einsum.  ``a``/``b`` may be logical (zero-padded here) or
+    already physical."""
+    m, k = lshape_a
+    k2, n = lshape_b
+    if k != k2:
+        raise ValueError(f"inner dims disagree: {lshape_a} @ {lshape_b}")
+    case = _classify(a_split, b_split)
+    comp = jnp.dtype(comp_dtype) if comp_dtype is not None else jnp.promote_types(
+        a.dtype, b.dtype
+    )
+    steps, extras = epilogue.lower() if epilogue is not None else ((), ())
+    acc_isz = 4 if (jnp.issubdtype(comp, jnp.inexact) and comp.itemsize < 4) else comp.itemsize
+    use, reason, bps = _decide(
+        case, out_split, m, k, n, comm.size, comp.itemsize, acc_isz
+    )
+    if not use:
+        _record("gspmd", steps=0, bps=bps, out_split=out_split, reason=reason)
+        return None
+    extra_axes = _extra_axes([tuple(v.shape) for v in extras], (m, n), out_split)
+    spec = _spec_for(
+        comm, case, out_split, m, k, n, comp, steps, extra_axes, precision,
+        fold=False,
+    )
+    a = _pad_physical(a, lshape_a, 0 if case == "ag" else 1, comm.size)
+    b = _pad_physical(b, lshape_b, 1 if case == "col" else 0, comm.size)
+    seen_key = (id(comm.mesh), spec)
+    hit = seen_key in _SEEN
+    _SEEN.add(seen_key)
+    fn = jit_shard_map_cached(_build_ring, comm.mesh, spec)
+    out = fn(a, b, *extras)
+    _record(
+        "ring_" + case, steps=comm.size, bps=bps, out_split=out_split,
+        reason=reason, cache_hit=hit,
+    )
+    return out
+
+
+def matmul(a, b, out_split="auto", *, epilogue: Optional[Epilogue] = None,
+           precision=None):
+    """Eager DNDarray entry: ring-dispatch ``a @ b`` (2-D), returning the
+    result DNDarray — or ``None`` when the dispatcher picks GSPMD (the
+    caller falls back to the einsum path, keeping this function decline-
+    safe).  ``out_split="auto"`` follows the reference convention
+    (row-split a → 0, col-split b → 1, inner split → replicated); the
+    ``rs`` case honors any explicit request directly."""
+    from ..core import types as _types
+    from ..core.dndarray import DNDarray
+
+    if a.ndim != 2 or b.ndim != 2 or a.comm.mesh != b.comm.mesh:
+        _record("gspmd", reason="layout")
+        return None
+    if out_split == "auto":
+        out_split = 0 if a.split == 0 else (1 if b.split == 1 else None)
+    promoted = _types.promote_types(a.dtype, b.dtype)
+    comp = jnp.dtype(promoted.jax_type())
+    steps, extras = epilogue.lower() if epilogue is not None else ((), ())
+    m, k = a.shape
+    n = b.shape[1]
+    if steps:
+        out_aval = jax.eval_shape(
+            lambda a_, b_, *ex: _apply_steps(
+                jnp.matmul(a_.astype(comp), b_.astype(comp)), steps, ex
+            ),
+            jax.ShapeDtypeStruct((m, k), a.parray.dtype),
+            jax.ShapeDtypeStruct((k, n), b.parray.dtype),
+            *extras,
+        )
+        if tuple(out_aval.shape) != (m, n):
+            raise ValueError(
+                f"epilogue changes the result shape to {out_aval.shape}"
+            )
+        out_dt = out_aval.dtype
+    else:
+        out_dt = comp
+    out = matmul_raw(
+        a.comm, a.parray, b.parray, (m, k), (k, n), a.split, b.split,
+        out_split, comp_dtype=comp, epilogue=epilogue, precision=precision,
+    )
+    if out is None:
+        return None
+    return DNDarray(
+        out, (m, n), _types.canonical_heat_type(out_dt), out_split,
+        a.device, a.comm,
+    )
+
+
+# ------------------------------------------------- fusion chain terminator
+
+def _mm(a, b):
+    """The matmul node of the fusion DAG.  The eager body is authoritative:
+    when the ring terminator declines (or fails), the generic fused program
+    evaluates this under GSPMD and correctness never depends on the
+    pattern match."""
+    return jnp.matmul(a, b)
+
+
+# chain ops that may ride the ring as epilogue steps: shape-preserving,
+# value-wise — reductions/scans/composites force the generic program
+_CHAIN_KINDS = {"elementwise", "cast", "comparison", "predicate"}
+
+_REGISTERED = False
+
+
+def ensure_registered() -> None:
+    """Idempotently register ``_mm`` and the chain terminator with the
+    fusion engine (lazy: parallel.overlap must stay importable before
+    heat_tpu.core finishes initializing)."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from ..core import fusion
+
+    fusion.register_op(_mm, "matmul", kind="matmul")
+    fusion.register_terminator(_lower_chain, salt=_dispatch_salt)
+    _REGISTERED = True
+
+
+def _split_of(value, mesh, axis) -> Optional[int]:
+    sh = getattr(value, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+        for i, names in enumerate(sh.spec):
+            if names == axis or (isinstance(names, tuple) and axis in names):
+                return i
+    return None
+
+
+def _chain_operand(instrs, c):
+    """A ``_mm`` operand slot: a leaf, optionally through one fused
+    operand cast.  → ``(leaf_index, cast_dtype_or_None)`` or None."""
+    from ..core import fusion
+
+    ins = instrs[c]
+    if ins[0] == "L":
+        return ins[1], None
+    _, fn, kw, ch = ins
+    if fn is fusion._astype and len(ch) == 1 and instrs[ch[0]][0] == "L":
+        return instrs[ch[0]][1], jnp.dtype(dict(kw)["dtype"])
+    return None
+
+
+def _lower_chain(instrs, leaves, out_slot, lshapes, gshape, split, comm,
+                 target, with_guard):
+    """Fusion-cache lowerer: recognize ``epilogue(...(_mm(a, b)))`` chains
+    and return a replacement program running the ring engine, with the
+    whole elementwise tail fused into the ring step.  Returns ``None`` to
+    decline (generic GSPMD program takes over)."""
+    from ..core import fusion
+
+    if len(gshape) != 2:
+        return None
+    if not any(ins[0] == "O" and ins[1] is _mm for ins in instrs):
+        return None
+    # walk root → _mm, collecting the elementwise tail
+    tail = []
+    slot = out_slot
+    while True:
+        ins = instrs[slot]
+        if ins[0] != "O":
+            return None
+        _, fn, kw, ch = ins
+        if fn is _mm:
+            mm_ch = ch
+            break
+        meta = fusion._OP_TABLE.get(fn)
+        if meta is None or meta[1] not in _CHAIN_KINDS:
+            return None
+        nxt = {c for c in ch if instrs[c][0] == "O"}
+        if len(nxt) != 1:
+            return None
+        tail.append((fn, kw, ch, slot))
+        slot = nxt.pop()
+    if len(mm_ch) != 2:
+        return None
+    opa = _chain_operand(instrs, mm_ch[0])
+    opb = _chain_operand(instrs, mm_ch[1])
+    if opa is None or opb is None:
+        return None
+    ia, cast_a = opa
+    ib, cast_b = opb
+    la, lb = lshapes[ia], lshapes[ib]
+    if len(la) != 2 or len(lb) != 2 or la[1] != lb[0]:
+        return None
+    m, k = la
+    n = lb[1]
+    if tuple(gshape) != (m, n):
+        return None
+    mesh, axis, S = comm.mesh, comm.split_axis, comm.size
+    a_val, b_val = leaves[ia].value, leaves[ib].value
+    a_split = _split_of(a_val, mesh, axis)
+    b_split = _split_of(b_val, mesh, axis)
+    case = _classify(a_split, b_split)
+    if case is None:
+        _record("gspmd", out_split=split, reason="layout")
+        return None
+    # physical layout sanity: the kernel's block algebra needs the
+    # even-chunk pad on the split dim
+    for v, ls, sp in ((a_val, la, a_split), (b_val, lb, b_split)):
+        if v.shape[sp] != _ceil_mult(ls[sp], S) or v.shape[1 - sp] != ls[1 - sp]:
+            return None
+    comp = jnp.promote_types(cast_a or a_val.dtype, cast_b or b_val.dtype)
+    acc_isz = 4 if (jnp.issubdtype(comp, jnp.inexact) and comp.itemsize < 4) else comp.itemsize
+    use, reason, bps = _decide(case, split, m, k, n, S, comp.itemsize, acc_isz)
+    if not use:
+        _record("gspmd", bps=bps, out_split=split, reason=reason)
+        return None
+    # bottom-up epilogue: each tail op becomes a ring step; its leaf
+    # operands become runtime extras (dim checks: ≤2-D, broadcast extents)
+    steps = []
+    extra_of = {}   # leaf index -> extras position
+    extra_shapes = []
+    chain_slot = slot  # the _mm slot
+    for fn, kw, ch, op_slot in reversed(tail):
+        pat = []
+        for c in ch:
+            if c == chain_slot:
+                pat.append(-1)
+                continue
+            ins_c = instrs[c]
+            if ins_c[0] != "L":
+                return None
+            li = ins_c[1]
+            es = lshapes[li]
+            if len(es) > 2:
+                return None
+            off = 2 - len(es)
+            if any(es[i] not in (1, gshape[i + off]) for i in range(len(es))):
+                return None
+            if li not in extra_of:
+                extra_of[li] = len(extra_shapes)
+                extra_shapes.append(es)
+            pat.append(extra_of[li])
+        steps.append((fn, kw or (), tuple(pat)))
+        chain_slot = op_slot
+    steps = tuple(steps)
+    extra_axes = _extra_axes(extra_shapes, gshape, split)
+    spec = _spec_for(
+        comm, case, split, m, k, n, comp, steps, extra_axes, None,
+        fold=with_guard,
+    )
+    kern = _build_ring(mesh, spec)
+    extra_leaf_idx = tuple(extra_of)
+    _record(
+        "ring_" + case, steps=S, bps=bps, out_split=split, reason=reason,
+    )
+
+    def program(*vals):
+        ex = []
+        for li in extra_leaf_idx:
+            v = vals[li]
+            ls = lshapes[li]
+            if tuple(v.shape) != ls:
+                v = v[tuple(slice(0, d) for d in ls)]
+            ex.append(v)
+        return kern(vals[ia], vals[ib], *ex)
+
+    return program

@@ -78,7 +78,13 @@ def _sq_euclidean(xa, ya):
         cross = jax.lax.dot_general(
             xa, ya, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-    return jnp.maximum(x2 + y2 - 2.0 * cross, 0.0)
+    d2 = x2 + y2 - 2.0 * cross
+    # noise floor: for a ≈ b the expansion cancels catastrophically and the
+    # residual is rounding noise of magnitude ~eps·(|a|²+|b|²) — clamp it to
+    # an exact 0 so self-distances come out 0, not sqrt(eps)·|a|
+    eps = jnp.finfo(d2.dtype).eps
+    d2 = jnp.where(d2 <= 4.0 * eps * (x2 + y2), 0.0, d2)
+    return jnp.maximum(d2, 0.0)
 
 
 def _euclid_kernel(xv, yv, dtype=None, sqrt=True):
@@ -173,29 +179,30 @@ def _pallas_rowsplit_cdist(x: DNDarray, y: DNDarray, ya, sqrt: bool) -> Optional
 
 
 def _build_ring_cdist(mesh, axis, n_dev, sqrt):
-    """shard_map kernel: x blocks stationary, y blocks rotate the ring."""
+    """shard_map kernel: x blocks stationary, y blocks rotate the ring via
+    :func:`heat_tpu.parallel.overlap.ring_sweep` — unrolled so each hop's
+    ``ppermute`` overlaps the previous round's MXU work (a ``fori_loop``
+    iteration is a scheduling barrier), and the useless final shift the old
+    loop performed is elided."""
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.collectives import ring_shift, shard_map_unchecked
+    from ..parallel.collectives import shard_map_unchecked
+    from ..parallel.overlap import ring_sweep
 
     def shard_fn(xs, ys):
         me = lax.axis_index(axis)
         mb = ys.shape[0]
 
-        def body(i, carry):
-            ys_rot, out = carry
-            # after i backward shifts this device holds the block that
-            # started on device (me - i) mod n — its column offset
-            col = (((me - i) % n_dev) * mb).astype(jnp.int32)
+        def body(t, ys_rot, out):
+            # after t backward shifts this device holds the block that
+            # started on device (me - t) mod n — its column offset
+            col = (((me - t) % n_dev) * mb).astype(jnp.int32)
             d2 = _sq_euclidean(xs, ys_rot)
-            out = lax.dynamic_update_slice(out, d2, (jnp.int32(0), col))
-            # one collective-permute per round rides the ICI ring links
-            ys_rot = ring_shift(ys_rot, axis, shift=1)
-            return ys_rot, out
+            return lax.dynamic_update_slice(out, d2, (jnp.int32(0), col))
 
         out = jnp.zeros((xs.shape[0], n_dev * mb), jnp.promote_types(xs.dtype, jnp.float32))
-        _, out = lax.fori_loop(0, n_dev, body, (ys, out))
+        out = ring_sweep(axis, n_dev, ys, out, body)
         return jnp.sqrt(out) if sqrt else out
 
     return shard_map_unchecked(

@@ -1,0 +1,207 @@
+"""Overlap-scheduled collective matmul (heat_tpu/parallel/overlap.py).
+
+Equality laws: the ring schedules must agree with the GSPMD einsum path to
+dtype tolerance for all three canonical sharded-GEMM cases — row-split ×
+row-split (``ag``), inner-split (``rs``), col-split × col-split (``col``) —
+at mesh sizes 1, 4 and 8, with and without fused epilogues.  Plus the
+engine's structural laws: the rs schedule lands the *requested* out-split
+directly (no resplit second pass), eager programs build once per
+(mesh, spec), and matmul-terminated fusion chains compile once.
+"""
+
+import unittest
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.parallel import overlap
+from .base import TestCase
+
+
+def _mesh(n):
+    from heat_tpu.parallel.mesh import local_mesh
+
+    return local_mesh(n)
+
+
+# shapes: (m, k, n); the uneven triple is indivisible by every mesh size so
+# each case exercises the zero-masked k-pads and the out-pad re-zeroing
+EVEN = (32, 24, 16)
+UNEVEN = (29, 21, 13)
+
+# a.split, b.split, natural out split
+CASES = {
+    "ag": (0, 0, 0),
+    "rs": (1, 0, None),
+    "col": (1, 1, 1),
+}
+
+
+class TestOverlapEngine(TestCase):
+    def setUp(self):
+        overlap.reset_stats()
+        overlap.set_mode(None)
+
+    def tearDown(self):
+        overlap.set_mode(None)
+
+    def _operands(self, seed, shape, splits, mesh, dtype=np.float32):
+        m, k, n = shape
+        rng = np.random.default_rng(seed)
+        A = rng.standard_normal((m, k)).astype(dtype)
+        B = rng.standard_normal((k, n)).astype(dtype)
+        a = ht.array(A, split=splits[0], comm=mesh)
+        b = ht.array(B, split=splits[1], comm=mesh)
+        return A, B, a, b
+
+    def _law(self, mesh, case, shape):
+        a_split, b_split, out_split = CASES[case]
+        A, B, a, b = self._operands(hash((case, shape)) % 2**31, shape, (a_split, b_split), mesh)
+        overlap.set_mode("ring")
+        ring = overlap.matmul(a, b)
+        self.assertIsNotNone(ring, f"{case} declined on mesh {mesh.size}")
+        self.assertEqual(overlap.stats()["last"]["schedule"], f"ring_{case}")
+        self.assertEqual(ring.split, out_split)
+        overlap.set_mode("gspmd")
+        self.assertIsNone(overlap.matmul(a, b))
+        gspmd = ht.matmul(a, b)
+        np.testing.assert_allclose(
+            ring.numpy(), gspmd.numpy(), rtol=2e-5, atol=2e-5
+        )
+        # per-shard oracle comparison: the ring's physical layout must BE the
+        # claimed split, not merely gather to the right values
+        self.assert_array_equal(ring, A @ B, rtol=2e-5, atol=2e-5)
+
+    def test_equality_laws_mesh4(self):
+        mesh = _mesh(4)
+        for case in CASES:
+            for shape in (EVEN, UNEVEN):
+                with self.subTest(case=case, shape=shape):
+                    self._law(mesh, case, shape)
+
+    def test_equality_laws_mesh8(self):
+        mesh = _mesh(8)
+        for case in CASES:
+            for shape in (EVEN, UNEVEN):
+                with self.subTest(case=case, shape=shape):
+                    self._law(mesh, case, shape)
+
+    def test_mesh1_declines_to_gspmd(self):
+        mesh = _mesh(1)
+        A, B, a, b = self._operands(5, EVEN, (0, 0), mesh)
+        overlap.set_mode("ring")
+        self.assertIsNone(overlap.matmul(a, b))
+        self.assertEqual(overlap.stats()["last"]["reason"], "mesh1")
+        self.assert_array_equal(ht.matmul(a, b), A @ B, rtol=2e-5, atol=2e-5)
+
+    def test_epilogue_bias_activation(self):
+        """scale·(a@b)+bias → activation → cast, fused into the ring kernel,
+        vs the identical jnp tail applied after the GSPMD product."""
+        for mesh_n in (4, 8):
+            mesh = _mesh(mesh_n)
+            for case in ("ag", "rs"):
+                a_split, b_split, out_split = CASES[case]
+                A, B, a, b = self._operands(11, UNEVEN, (a_split, b_split), mesh)
+                m, _, n = UNEVEN
+                scale = jnp.float32(0.5)
+                # ag: a (m, 1) column bias rides the out-split slicing path;
+                # rs: a replicated (n,) row bias
+                bias = (
+                    jnp.asarray(np.linspace(-1, 1, m, dtype=np.float32)[:, None])
+                    if case == "ag"
+                    else jnp.asarray(np.linspace(-1, 1, n, dtype=np.float32))
+                )
+                epi = overlap.Epilogue(
+                    scale=scale, bias=bias, activation=jax.nn.gelu,
+                    dtype=jnp.float32,
+                )
+                overlap.set_mode("ring")
+                ring = overlap.matmul(a, b, epilogue=epi)
+                with self.subTest(case=case, mesh=mesh_n):
+                    self.assertIsNotNone(ring)
+                    oracle = jax.nn.gelu(
+                        scale * jnp.asarray(A @ B) + bias
+                    ).astype(jnp.float32)
+                    self.assert_array_equal(
+                        ring, np.asarray(oracle), rtol=2e-5, atol=2e-5
+                    )
+
+    def test_rs_lands_requested_out_split_directly(self):
+        """Inner-split product must come out OF THE RING in the requested
+        split — the per-shard oracle check fails if a resplit pass (or no
+        pass) faked it."""
+        mesh = _mesh(4)
+        for req in (0, 1, None):
+            A, B, a, b = self._operands(13, EVEN, (1, 0), mesh)
+            overlap.set_mode("ring")
+            ring = overlap.matmul(a, b, out_split=req)
+            with self.subTest(out_split=req):
+                self.assertIsNotNone(ring)
+                last = overlap.stats()["last"]
+                self.assertEqual(last["schedule"], "ring_rs")
+                self.assertEqual(last["out_split"], req)
+                self.assertEqual(ring.split, req)
+                self.assert_array_equal(ring, A @ B, rtol=2e-5, atol=2e-5)
+
+    def test_eager_programs_build_once(self):
+        """Second eager call with NEW operand arrays (same spec) is a cache
+        hit — no retrace, no rebuild."""
+        mesh = _mesh(4)
+        overlap.set_mode("ring")
+        _, _, a, b = self._operands(17, EVEN, (0, 0), mesh)
+        overlap.matmul(a, b).numpy()
+        builds = overlap.stats()["ring_builds"]
+        _, _, a2, b2 = self._operands(19, EVEN, (0, 0), mesh)
+        overlap.matmul(a2, b2).numpy()
+        st = overlap.stats()
+        self.assertEqual(st["ring_builds"], builds)
+        self.assertGreaterEqual(st["cache_hits"], 1)
+
+    @unittest.skipUnless(fusion.enabled(), "fusion engine disabled (HEAT_TPU_FUSE=off)")
+    def test_fused_chain_compiles_once_and_rides_ring(self):
+        """A matmul-terminated lazy chain enters the fusion compile cache
+        exactly once; a second run with fresh constants is a cache hit and
+        builds no new ring program."""
+        fusion.reset_cache()
+        overlap.set_mode("ring")
+        mesh = _mesh(4)
+
+        def run(seed):
+            A, B, a, b = self._operands(seed, EVEN, (0, 0), mesh)
+            out = ht.matmul(a, b) + 1.0
+            return A, B, out.numpy()
+
+        A, B, got = run(23)
+        st = fusion.cache_stats()
+        self.assertEqual(st["misses"], 1)
+        np.testing.assert_allclose(got, A @ B + 1.0, rtol=2e-5, atol=2e-5)
+        self.assertEqual(overlap.stats()["by_schedule"]["ring_ag"], 1)
+        builds = overlap.stats()["ring_builds"]
+
+        A2, B2, got2 = run(29)
+        st = fusion.cache_stats()
+        self.assertEqual(st["misses"], 1)
+        self.assertGreaterEqual(st["hits"], 1)
+        self.assertEqual(overlap.stats()["ring_builds"], builds)
+        np.testing.assert_allclose(got2, A2 @ B2 + 1.0, rtol=2e-5, atol=2e-5)
+
+    @unittest.skipUnless(fusion.enabled(), "fusion engine disabled (HEAT_TPU_FUSE=off)")
+    def test_mode_flip_builds_distinct_cache_entry(self):
+        """HEAT_TPU_MATMUL participates in the fusion cache key: flipping
+        the mode must NOT reuse the other mode's executable."""
+        fusion.reset_cache()
+        mesh = _mesh(4)
+        A, B, a, b = self._operands(31, EVEN, (0, 0), mesh)
+        overlap.set_mode("ring")
+        (ht.matmul(a, b) + 1.0).numpy()
+        overlap.set_mode("gspmd")
+        (ht.matmul(a, b) + 1.0).numpy()
+        self.assertEqual(fusion.cache_stats()["misses"], 2)
+
+
+if __name__ == "__main__":
+    unittest.main()

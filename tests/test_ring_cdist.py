@@ -62,6 +62,36 @@ class TestRingCdist(TestCase):
         np.testing.assert_allclose(d, d.T, atol=1e-4)
         np.testing.assert_allclose(np.diag(d), 0.0, atol=1e-3)
 
+    def _census_law(self, mesh):
+        """Ring result == one-shot GSPMD result on the same mesh: both paths
+        share ``_sq_euclidean``, so the schedules must agree to float
+        tolerance — any drift means the ring mis-placed a column block."""
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal((48, 5)).astype(np.float32)
+        b = rng.standard_normal((32, 5)).astype(np.float32)
+        ring = ht.spatial.cdist(
+            ht.array(a, split=0, comm=mesh), ht.array(b, split=0, comm=mesh)
+        )
+        # y replicated → not ring-eligible → GSPMD/local one-shot path
+        gspmd = ht.spatial.cdist(
+            ht.array(a, split=0, comm=mesh), ht.array(b, comm=mesh)
+        )
+        self.assertEqual(ring.split, 0)
+        np.testing.assert_allclose(
+            ring.numpy(), gspmd.numpy(), rtol=1e-6, atol=1e-6
+        )
+        self.assert_array_equal(ring, _dense(a, b).astype(np.float32), rtol=1e-4, atol=1e-4)
+
+    def test_census_law_mesh4(self):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        self._census_law(local_mesh(4))
+
+    def test_census_law_mesh8(self):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        self._census_law(local_mesh(8))
+
     def test_bf16_inputs(self):
         rng = np.random.default_rng(4)
         a = rng.standard_normal((32, 4)).astype(np.float32)
