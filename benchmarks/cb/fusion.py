@@ -10,17 +10,27 @@
 #    with HEAT_TPU_GUARD on vs off.  The guard adds a site capture per op
 #    node and one isfinite-reduce program per materialization; the row
 #    measures that instead of assuming it (<5% is the acceptance bar).
+#  * fusion_multi_out — the DAG scheduler (ISSUE 7): mean+var of one chain
+#    batched by ht.materialize into ONE 2-output program (shared subtree
+#    deduplicated by CSE) vs two independent materializations.
+#  * resplit_fused_tail — the split-boundary terminator (ISSUE 7): a lazy
+#    elementwise chain ending in .resplit(1), lowered INTO the transport
+#    tile loop vs materialize-then-resplit.
 #
 # ``python fusion.py --verify-cache`` is the CI retrace guard: it runs each
 # benchmark chain twice and fails (exit 1) if the second invocation reports
 # any new compile-cache miss — i.e. if a fingerprint regression makes the
-# steady state retrace.
+# steady state retrace.  ``--verify-multi`` is the ISSUE-7 guard: the
+# 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
+# second call a pure hit) and the resplit-terminated chain must reach the
+# transport loop without a pre-pass materialization.
 import argparse
 import sys
 
 import heat_tpu as ht
 from heat_tpu.core import fusion as ht_fusion
 from heat_tpu.core import guard as ht_guard
+from heat_tpu.parallel import transport as ht_transport
 from heat_tpu.utils.monitor import record
 
 import config
@@ -29,6 +39,8 @@ import config
 # neighbouring suites (config.py): CI sizes on CPU, larger on TPU
 CHAIN_N = 8_000_000 if config.ON_TPU else 400_000
 STEP_N, STEP_F, STEP_K = (2_000_000, 64, 8) if config.ON_TPU else (20_000, 8, 8)
+MO_N = 4_000_000 if config.ON_TPU else 200_000
+RS_R, RS_C = (4096, 4096) if config.ON_TPU else (256, 192)
 
 
 def _chain(x, y):
@@ -117,6 +129,86 @@ def run():
              "overhead_frac < 0.05.",
     )
 
+    # fusion_multi_out: mean+var of one chain as ONE 2-output program
+    # (shared (x-3)*2 subtree deduplicated) vs two independent
+    # materializations that each rebuild and re-run the subtree.
+    xm = ht.random.randn(MO_N, split=0)
+
+    def multi_k(k):
+        out = None
+        for _ in range(k):
+            ym = (xm - 3.0) * 2.0
+            m, v = ym.mean(), ym.var()
+            ht.materialize(m, v)
+            out = m.larray
+        config.drain(out)
+
+    def separate_k(k):
+        out = None
+        for _ in range(k):
+            m = ((xm - 3.0) * 2.0).mean()
+            out = m.larray
+            v = ((xm - 3.0) * 2.0).var()
+            out = v.larray
+        config.drain(out)
+
+    multi_k(1)  # warmup: compile the 2-output executable
+    sl = config.slope(multi_k)
+    separate_k(1)
+    sl_sep = config.slope(separate_k)
+    record(
+        "fusion_multi_out", sl.per_unit_s, per="mean+var",
+        n=MO_N, separate_per_unit_s=round(sl_sep.per_unit_s, 6),
+        speedup_vs_separate=round(sl_sep.per_unit_s / sl.per_unit_s, 3),
+        **sl.fields(),
+        # mandatory traffic of the batched form: ONE read of x, two scalar
+        # writes; the separate form reads x (and re-runs the sub/mul) twice
+        **config.hbm_fields(MO_N * 4.0, sl.per_unit_s),
+        note="DAG scheduler: one 2-output executable (1 miss, shared "
+             "subtree CSE'd) vs two single-output programs that each "
+             "re-read x and re-execute the chain. On the CPU CI mesh both "
+             "arms are dispatch-bound, so the roofline fraction is low by "
+             "construction; speedup_vs_separate is the score.",
+    )
+
+    # resplit_fused_tail: elementwise chain terminated by a split change,
+    # lowered INTO the per-tile all_to_all loop vs materialize-then-resplit.
+    src = ht.random.randn(RS_R, RS_C, split=0)
+
+    def fused_tail_k(k):
+        out = None
+        for _ in range(k):
+            out = (ht.exp(src * 0.1) - 1.0).resplit(1).parray
+        config.drain(out)
+
+    def prepass_k(k):
+        out = None
+        for _ in range(k):
+            y = ht.exp(src * 0.1) - 1.0
+            y.larray  # materialize in the OLD split first
+            out = y.resplit(1).parray
+        config.drain(out)
+
+    fused_tail_k(1)  # warmup: compile the fused tile program
+    sl = config.slope(fused_tail_k)
+    prepass_k(1)
+    sl_pre = config.slope(prepass_k)
+    record(
+        "resplit_fused_tail", sl.per_unit_s, per="chain+resplit",
+        rows=RS_R, cols=RS_C,
+        prepass_per_unit_s=round(sl_pre.per_unit_s, 6),
+        speedup_vs_prepass=round(sl_pre.per_unit_s / sl.per_unit_s, 3),
+        **sl.fields(),
+        # fused: one read of the source slab + one write in the new split;
+        # the pre-pass arm adds a full materialize write + re-read between
+        **config.hbm_fields(2.0 * RS_R * RS_C * 4.0, sl.per_unit_s),
+        note="split-boundary terminator: the chain tail executes inside "
+             "the tiled all_to_all loop (tile-k compute overlaps the "
+             "tile-k+1 collective), skipping the old-split materialization "
+             "round trip. CPU CI is dispatch/latency-bound, not HBM-bound; "
+             "speedup_vs_prepass carries the signal.",
+    )
+
     step_k = _make_step()
     step_k(1)  # warmup: compile the fused cdist+argmin executable
     sl = config.slope(step_k)
@@ -168,11 +260,76 @@ def verify_cache() -> int:
     return 0
 
 
+def verify_multi() -> int:
+    """ISSUE-7 CI guard: multi-output batching and the split-boundary
+    terminator must keep their compile/CSE contracts.
+
+    (a) ``materialize(mean, var)`` of one chain compiles ONE 2-output
+        executable (exactly 1 miss, >=1 cse_hit — the CSE-regression
+        check) and the second same-shape call is a pure cache hit (the
+        multi-output retrace guard).
+    (b) a resplit-terminated elementwise chain reaches the transport tile
+        loop with ZERO fused-engine programs (no pre-pass) and at least
+        one counted fused tail."""
+    failures = []
+
+    ht_fusion.reset_cache()
+    x = ht.random.randn(65_536, split=0)
+
+    def mean_var():
+        y = (x - 3.0) * 2.0
+        m, v = y.mean(), y.var()
+        ht.materialize(m, v)
+
+    ht_fusion.reset_cache()
+    mean_var()
+    first = ht_fusion.cache_stats()
+    if first["misses"] != 1:
+        failures.append(f"multi-out compiled {first['misses']} programs, want 1")
+    if first["cse_hits"] < 1:
+        failures.append(f"CSE regression: cse_hits={first['cse_hits']}, want >=1")
+    if first["roots_per_program"].get(2, 0) != 1:
+        failures.append(f"roots_per_program={first['roots_per_program']}, want one 2-root program")
+    mean_var()
+    second = ht_fusion.cache_stats()
+    if second["misses"] != first["misses"] or second["hits"] <= first["hits"]:
+        failures.append(f"multi-out retrace: first={first} second={second}")
+    print(f"fusion_multi_out: first={first} second={second} -> "
+          f"{'OK' if not failures else 'FAIL'}")
+
+    pre_fail = len(failures)
+    src = ht.random.randn(128, 96, split=0)
+    ht_fusion.reset_cache()
+    ht_transport.reset_stats()
+    _ = (ht.exp(src * 0.1) - 1.0).resplit(1).parray
+    fstats = ht_fusion.cache_stats()
+    tstats = ht_transport.stats()
+    if fstats["misses"] != 0:
+        failures.append(
+            f"resplit tail paid a pre-pass materialization ({fstats['misses']} misses)"
+        )
+    if tstats["fused_tails"] < 1:
+        failures.append(f"no fused tail counted: {tstats}")
+    print(f"resplit_fused_tail: fusion={fstats['misses']} misses, "
+          f"fused_tails={tstats['fused_tails']} -> "
+          f"{'OK' if len(failures) == pre_fail else 'FAIL'}")
+
+    if failures:
+        print("FAIL:", "; ".join(failures))
+        return 1
+    print("multi-output verify OK: one executable, CSE live, tail fused")
+    return 0
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--verify-cache", action="store_true",
                     help="retrace guard: fail on a second-call cache miss")
+    ap.add_argument("--verify-multi", action="store_true",
+                    help="ISSUE-7 guard: multi-output retrace + CSE + fused tail")
     args = ap.parse_args()
     if args.verify_cache:
         sys.exit(verify_cache())
+    if args.verify_multi:
+        sys.exit(verify_multi())
     run()

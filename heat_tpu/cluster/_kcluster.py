@@ -216,21 +216,30 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             types.canonical_heat_type(centroids.dtype), None, x.device, x.comm,
         )
 
-    def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
+    def _assign_to_cluster(self, x: DNDarray, return_inertia: bool = False):
         """Assign each sample to its closest centroid (reference:
-        _kcluster.py:196)."""
-        from ..core import statistics
+        _kcluster.py:196).  With ``return_inertia`` the min-distance sum
+        rides along as a second root of the SAME fused program — the
+        cdist subtree is shared through the scheduler's CSE, so labels and
+        inertia cost one compile and one dispatch, not two cdists."""
+        from ..core import fusion, statistics
 
         # the distance update rides the fusion engine: a GSPMD cdist defers a
         # lazy DAG and this argmin extends it, so distances + labels lower as
         # one cached executable per (shape, sharding) key
         distances = self._metric(x, self._cluster_centers)
         labels = statistics.argmin(distances, axis=1, keepdims=True)
+        if return_inertia:
+            inertia = statistics.min(distances, axis=1).sum()
+            fusion.materialize(labels, inertia)
+            inertia_val = float(jnp.asarray(inertia.larray).reshape(()))
         if labels.split != x.split:
             out = DNDarray(
                 labels.larray, labels.gshape, labels.dtype, x.split, x.device, x.comm
             )
-            return _ensure_split(out, x.split)
+            labels = _ensure_split(out, x.split)
+        if return_inertia:
+            return labels, inertia_val
         return labels
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
@@ -261,7 +270,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             centers, tuple(centers.shape),
             types.canonical_heat_type(centers.dtype), None, x.device, x.comm,
         )
-        self._labels = self._assign_to_cluster(x)
+        self._labels, self._inertia = self._assign_to_cluster(x, return_inertia=True)
         return self
 
     def predict(self, x: DNDarray) -> DNDarray:

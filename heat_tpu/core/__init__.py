@@ -37,6 +37,8 @@ from .dndarray import *
 from . import factories
 from .factories import *
 from . import _operations
+from . import fusion
+from .fusion import materialize, materialize_all
 from . import sanitation
 from .sanitation import *
 from . import stride_tricks

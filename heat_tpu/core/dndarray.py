@@ -460,15 +460,27 @@ class DNDarray:
         if axis == self.__split:
             return self
         if transport.resplit_applicable(self.__gshape, self.__split, axis, self.__comm):
-            # a pending fused expression may hold this buffer as a DAG leaf;
-            # donating it would make that chain's later materialization a
-            # use-after-free — fall back to a non-donating move then
-            from .fusion import safe_to_donate
+            from .fusion import materialize_resplit, safe_to_donate
 
-            self.__array = transport.tiled_resplit(
-                self.__array, self.__gshape, self.__split, axis, self.__comm,
-                donate=safe_to_donate(self.__array),
-            )
+            # a still-pending lazy chain lowers its elementwise tail into
+            # the per-tile all_to_all loop — the old-split value is never
+            # materialized at all.  The expression is NOT leafified: the
+            # fused output is in the NEW layout, and other consumers of
+            # the chain still expect the old-split value.
+            fused = materialize_resplit(self, axis)
+            if fused is not None:
+                object.__setattr__(self, "_DNDarray__array", fused)
+                if self.__dict__.get("_expr") is not None:
+                    object.__setattr__(self, "_expr", None)
+            else:
+                # a pending fused expression may hold this buffer as a DAG
+                # leaf; donating it would make that chain's later
+                # materialization a use-after-free — fall back to a
+                # non-donating move then
+                self.__array = transport.tiled_resplit(
+                    self.__array, self.__gshape, self.__split, axis, self.__comm,
+                    donate=safe_to_donate(self.__array),
+                )
         else:
             self.__array = _to_physical(self.larray, self.__gshape, axis, self.__comm)
         self.__split = axis

@@ -69,3 +69,16 @@ DNDarray.log10 = lambda self, out=None: log10(self, out)
 DNDarray.log1p = lambda self, out=None: log1p(self, out)
 DNDarray.sqrt = lambda self, out=None: sqrt(self, out)
 DNDarray.square = lambda self, out=None: square(self, out)
+
+# display names + kinds for the fusion engine's op table (arithmetics.py
+# keeps the binary table); "elementwise" marks these as shape-preserving
+# maps the transport fused-tail lowerer may replay per tile
+from . import fusion as _fusion
+
+for _fn, _name in [
+    (jnp.exp, "exp"), (jnp.exp2, "exp2"), (jnp.expm1, "expm1"),
+    (jnp.log, "log"), (jnp.log2, "log2"), (jnp.log10, "log10"),
+    (jnp.log1p, "log1p"), (jnp.sqrt, "sqrt"), (jnp.square, "square"),
+    (jnp.cbrt, "cbrt"),
+]:
+    _fusion.register_op(_fn, _name, kind="elementwise")

@@ -70,14 +70,19 @@ __all__ = [
     "Unfusable",
     "cache_stats",
     "defer",
+    "describe",
     "enabled",
     "fuse",
     "last_hlo",
     "leaf",
     "leaf_from",
+    "materialize",
+    "materialize_all",
+    "materialize_resplit",
     "node",
     "op_name",
     "register_op",
+    "register_split_terminator",
     "register_terminator",
     "reset_cache",
     "safe_to_donate",
@@ -305,10 +310,25 @@ def cast_node(child: Expr, dtype) -> Expr:
     return node(_astype, (child,), dtype=jnp.dtype(dtype))
 
 
-def _render_instrs(instrs, leaves, out_slot, upto=None, mark=None) -> str:
+def _render_instrs(instrs, leaves, out_slots, upto=None, mark=None) -> str:
     """Shared renderer behind :func:`describe` and the guard's offending-
     subtree report.  ``upto`` truncates after that slot; ``mark`` annotates
-    one slot (the first non-finite producer)."""
+    one slot (the first non-finite producer).
+
+    A slot consumed more than once — by several op nodes, several program
+    outputs, or both — is a shared subexpression: it renders ONCE, tagged
+    ``<<shared xN>>`` with its consumer count, instead of being re-printed
+    per consumer (the instruction list is already in deduplicated form, so
+    re-printing would misreport the program as executing it N times)."""
+    if isinstance(out_slots, int):
+        out_slots = (out_slots,)
+    refs: "dict[int, int]" = {}
+    for ins in instrs:
+        if ins[0] == "O":
+            for c in ins[3]:
+                refs[c] = refs.get(c, 0) + 1
+    for s in out_slots:
+        refs[s] = refs.get(s, 0) + 1
     last = len(instrs) - 1 if upto is None else int(upto)
     lines = []
     for i, ins in enumerate(instrs[: last + 1]):
@@ -319,41 +339,77 @@ def _render_instrs(instrs, leaves, out_slot, upto=None, mark=None) -> str:
             _, fn, kw, ch = ins
             kws = f" {dict(kw)}" if kw else ""
             line = f"%{i} = {op_name(fn)}({', '.join('%%%d' % c for c in ch)}){kws}"
+        if refs.get(i, 0) > 1:
+            line += f"   <<shared x{refs[i]}>>"
         if mark is not None and i == mark:
             line += "   <-- first non-finite"
         lines.append(line)
-    lines.append(f"return %{out_slot if upto is None else last}")
+    if upto is None:
+        lines.append("return " + ", ".join(f"%{s}" for s in out_slots))
+    else:
+        lines.append(f"return %{last}")
     return "\n".join(lines)
 
 
-def describe(expr: Expr) -> str:
-    """Human-readable postorder rendering of the DAG (debugging aid)."""
-    instrs, _, leaves, out_slot = _linearize(expr)
-    return _render_instrs(instrs, leaves, out_slot)
+def describe(*exprs) -> str:
+    """Human-readable postorder rendering of one or more DAG roots
+    (debugging aid).  Accepts :class:`Expr` roots or (lazy) DNDarrays;
+    several roots render as ONE deduplicated instruction list with a
+    multi-value ``return`` — exactly the program :func:`materialize_all`
+    would compile — and subtrees consumed more than once carry a
+    ``<<shared xN>>`` ref-mark instead of being printed per consumer."""
+    roots = []
+    for e in exprs:
+        if isinstance(e, Expr):
+            roots.append(e)
+        elif isinstance(e, DNDarray):
+            roots.append(leaf_from(e))
+        else:
+            raise TypeError(f"describe() takes Expr or DNDarray, got {type(e)}")
+    instrs, _, leaves, out_slots = _linearize(*roots)
+    return _render_instrs(instrs, leaves, out_slots)
 
 
 # -------------------------------------------------- fingerprint + lowering
 
-def _linearize(root: Expr):
-    """Postorder-linearize the DAG into ``(instrs, sites, leaves, out_slot)``.
+def _linearize(*roots: Expr):
+    """Postorder-linearize one or more DAG roots into
+    ``(instrs, sites, leaves, out_slots)``.
 
     ``instrs`` is the canonical serialization the compile cache keys on:
     leaves become ``("L", leaf_index)`` numbered by first encounter, op
-    nodes ``("O", fn, kwargs_key, child_slots)``.  Shared subgraphs get one
-    slot (a diamond serializes each node once).  ``sites`` is the parallel
-    per-slot provenance (guard.py user lines) — kept OUT of ``instrs`` so
-    the same chain built from two source locations shares one cache entry."""
+    nodes ``("O", fn, kwargs_key, child_slots)``.  All roots share ONE
+    instruction list — ``out_slots`` names each root's result slot — so a
+    subtree reachable from several roots is scheduled exactly once.
+
+    Deduplication is two-level.  Node identity: a diamond (the same
+    ``Expr`` object reached twice) serializes once.  Structural CSE: two
+    *distinct* op nodes with the same fingerprint — op object, kwargs key,
+    child slots, the same scheme the cache key uses — collapse to one
+    slot, so independently built copies of a subexpression (``mean`` and
+    ``var`` each re-deriving ``(x - mu)``) execute once inside the fused
+    program.  Every op-node reuse from either level counts as a
+    ``cse_hits`` event in :func:`cache_stats`.
+
+    ``sites`` is the parallel per-slot provenance (guard.py user lines) —
+    kept OUT of ``instrs`` so the same chain built from two source
+    locations shares one cache entry; a structurally merged node keeps the
+    site of its first builder."""
     instrs = []
     sites = []
     leaves = []
     slot: "dict[int, int]" = {}
     leaf_slot: "dict[tuple, int]" = {}
+    struct_slot: "dict[tuple, int]" = {}
     keepalive = []  # id()-keyed dict needs the nodes alive for the walk
 
     def visit(n: Expr) -> int:
         nid = id(n)
-        if nid in slot:
-            return slot[nid]
+        hit = slot.get(nid)
+        if hit is not None:
+            if instrs[hit][0] == "O":
+                _STATS["cse_hits"] += 1
+            return hit
         keepalive.append(n)
         if n.value is not None:
             # two leaf nodes wrapping the same buffer collapse to one
@@ -368,28 +424,39 @@ def _linearize(root: Expr):
             leaf_slot[lk] = len(instrs) - 1
         else:
             ch = tuple(visit(c) for c in n.args)
+            sk = (n.fn, n.kwargs, ch)
+            hit = struct_slot.get(sk)
+            if hit is not None:
+                _STATS["cse_hits"] += 1
+                slot[nid] = hit
+                return hit
             instrs.append(("O", n.fn, n.kwargs, ch))
             sites.append(n.site)
+            struct_slot[sk] = len(instrs) - 1
         slot[nid] = len(instrs) - 1
         return slot[nid]
 
-    out_slot = visit(root)
-    return tuple(instrs), tuple(sites), leaves, out_slot
+    out_slots = tuple(visit(r) for r in roots)
+    return tuple(instrs), tuple(sites), leaves, out_slots
 
 
 def _build_program(
-    instrs, out_slot, lshapes, gshape, split, nshards, target, with_guard=False
+    instrs, out_slots, lshapes, gshapes, splits, nshards, targets, with_guard=False
 ):
     """The single fused computation for one cache entry: slice leaf pads to
-    logical, evaluate the DAG, pad the result to its physical shape and pin
-    the canonical NamedSharding — the whole `_ensure_split` finalization
-    happens *inside* the program instead of as a separate dispatch.
+    logical, evaluate the DAG once, and — for EVERY output slot — pad the
+    result to its physical shape and pin its canonical NamedSharding; the
+    whole `_ensure_split` finalization happens *inside* the program instead
+    of as a separate dispatch.  Returns a flat tuple, one array per root.
+    A subtree feeding several roots executes once (the instruction list is
+    already in deduplicated form).
 
     ``with_guard=True`` folds the non-finite guard's reduction into the
-    SAME executable: the program returns ``(out, allfinite)`` so the guard
-    costs zero extra dispatches on the hot path (a separate jitted
-    isfinite program measured ~10x the acceptable tax on the CPU CI mesh).
-    Guard-off programs are byte-identical to the unguarded build."""
+    SAME executable: the program appends one joint ``allfinite`` scalar
+    (AND over all outputs) to the tuple, so the guard costs zero extra
+    dispatches on the hot path (a separate jitted isfinite program
+    measured ~10x the acceptable tax on the CPU CI mesh).  Guard-off
+    programs are byte-identical to the unguarded build."""
 
     def program(*vals):
         env = []
@@ -403,23 +470,23 @@ def _build_program(
             else:
                 _, fn, kw, ch = ins
                 env.append(fn(*[env[c] for c in ch], **dict(kw or ())))
-        out = env[out_slot]
-        if with_guard:
-            # on the logical (pre-pad) output: pad zeros are always finite
-            flag = (
-                jnp.all(jnp.isfinite(out))
-                if jnp.issubdtype(jnp.result_type(out), jnp.inexact)
-                else jnp.asarray(True)
-            )
-        if split is not None and gshape:
-            n = gshape[split]
-            pn = _physical_dim(n, nshards)
-            if pn != n:
-                pad = [(0, 0)] * len(gshape)
-                pad[split] = (0, pn - n)
-                out = jnp.pad(out, pad)
-        out = jax.lax.with_sharding_constraint(out, target)
-        return (out, flag) if with_guard else out
+        outs = []
+        flag = jnp.asarray(True) if with_guard else None
+        for out_slot, gshape, split, target in zip(out_slots, gshapes, splits, targets):
+            out = env[out_slot]
+            if with_guard and jnp.issubdtype(jnp.result_type(out), jnp.inexact):
+                # on the logical (pre-pad) output: pad zeros are always finite
+                flag = jnp.logical_and(flag, jnp.all(jnp.isfinite(out)))
+            if split is not None and gshape:
+                n = gshape[split]
+                pn = _physical_dim(n, nshards)
+                if pn != n:
+                    pad = [(0, 0)] * len(gshape)
+                    pad[split] = (0, pn - n)
+                    out = jnp.pad(out, pad)
+            out = jax.lax.with_sharding_constraint(out, target)
+            outs.append(out)
+        return tuple(outs) + ((flag,) if with_guard else ())
 
     return program
 
@@ -481,7 +548,11 @@ class _Entry:
 
 _CACHE: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _CACHE_MAX = int(os.environ.get("HEAT_TPU_FUSE_CACHE_SIZE", "4096"))
-_STATS = {"hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0}
+_STATS = {"hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0, "cse_hits": 0}
+# output-arity histogram of compiled programs: {n_roots: misses at that
+# arity}.  A serving steady state shows this frozen; a growing multi-root
+# bucket on repeated materialize_all() calls is a retrace regression.
+_ROOTS_PER_PROGRAM: "dict[int, int]" = {}
 # per-reason breakdown of the `fallbacks` total:
 #   unfusable     — op declined to enter the DAG (built eagerly instead)
 #   compile_error — fused program failed to trace/compile/first-run;
@@ -503,8 +574,24 @@ def cache_stats() -> dict:
     ``guard_replay``).  A serving steady state shows misses flat and hits
     climbing — a miss on a repeated chain is a retrace regression; a
     climbing ``compile_error``/``exec_error`` bucket means fused programs
-    are failing and silently running degraded."""
-    return {"size": len(_CACHE), **_STATS, "fallback_reasons": dict(_FALLBACK_REASONS)}
+    are failing and silently running degraded.
+
+    DAG-scheduler counters: ``cse_hits`` counts op-subtree reuse events
+    during linearization — every time a root (or another consumer) resolves
+    to an already-scheduled op slot instead of re-emitting its subtree,
+    whether by node identity (a diamond / several roots over one producer)
+    or by structural fingerprint (independently built copies of the same
+    subexpression).  ``roots_per_program`` is the output-arity histogram of
+    compiled programs (``{1: single-root misses, 2: two-output misses,
+    ...}``): `materialize_all` traffic shows up as multi-root buckets, and
+    a bucket that keeps growing on repeated same-shape calls is a
+    multi-output retrace regression."""
+    return {
+        "size": len(_CACHE),
+        **_STATS,
+        "fallback_reasons": dict(_FALLBACK_REASONS),
+        "roots_per_program": dict(_ROOTS_PER_PROGRAM),
+    }
 
 
 def reset_cache() -> None:
@@ -514,6 +601,7 @@ def reset_cache() -> None:
         _STATS[k] = 0
     for k in _FALLBACK_REASONS:
         _FALLBACK_REASONS[k] = 0
+    _ROOTS_PER_PROGRAM.clear()
 
 
 def count_fallback(reason: str = "unfusable") -> None:
@@ -567,9 +655,12 @@ def _finalize_eager(out, gshape, split, nshards, target):
     return jax.device_put(out, target)
 
 
-def _eager_fallback(instrs, vals, lshapes, out_slot, gshape, split, comm, target):
+def _eager_fallback(instrs, vals, lshapes, out_slots, gshapes, splits, comm, targets):
     env = _eager_eval(instrs, vals, lshapes)
-    return _finalize_eager(env[out_slot], tuple(gshape), split, comm.size, target)
+    return tuple(
+        _finalize_eager(env[s], tuple(g), sp, comm.size, tg)
+        for s, g, sp, tg in zip(out_slots, gshapes, splits, targets)
+    )
 
 
 @jax.jit
@@ -599,17 +690,43 @@ def _host_finite(out) -> bool:
     return bool(np.isfinite(arr).all())
 
 
-def _guard_check(out, instrs, sites, leaves, lshapes, out_slot, fast_flag=None):
+def _reaches(instrs, root_slot, target_slot) -> bool:
+    """Whether ``target_slot`` is in the subtree of ``root_slot`` (used to
+    attribute a shared offending node to every consuming output)."""
+    memo: "dict[int, bool]" = {}
+
+    def walk(s):
+        if s == target_slot:
+            return True
+        got = memo.get(s)
+        if got is not None:
+            return got
+        ins = instrs[s]
+        memo[s] = r = ins[0] == "O" and any(walk(c) for c in ins[3])
+        return r
+
+    return walk(root_slot)
+
+
+def _guard_check(outs, instrs, sites, leaves, lshapes, out_slots, fast_flag=None):
     """Raise :class:`NonFiniteError` when the chain *introduced* NaN/Inf.
 
-    Fast path: the ``allfinite`` scalar the fused program already computed
-    (``fast_flag``, large outputs), or a host-side numpy pass over the
-    fetched output (small outputs / eager-fallback results).  Only when
-    that trips: if any input leaf already carried non-finite values the
-    chain merely propagated them (nansum-style workflows are legal) and
-    nothing is raised; otherwise the linearized DAG replays eagerly
-    op-by-op to name the first op whose finite inputs went non-finite."""
-    if bool(fast_flag) if fast_flag is not None else _host_finite(out):
+    ``outs``/``out_slots`` cover every root of the (possibly multi-output)
+    program.  Fast path: the joint ``allfinite`` scalar the fused program
+    already computed (``fast_flag``, large outputs), or a host-side numpy
+    pass over the fetched outputs (small outputs / eager-fallback
+    results).  Only when that trips: if any input leaf already carried
+    non-finite values the chain merely propagated them (nansum-style
+    workflows are legal) and nothing is raised; otherwise the linearized
+    DAG replays eagerly op-by-op — ONCE, over the deduplicated instruction
+    list, so a shared node is evaluated and blamed once — to name the
+    first op whose finite inputs went non-finite, plus every program
+    output its subtree feeds."""
+    if (
+        bool(fast_flag)
+        if fast_flag is not None
+        else all(_host_finite(o) for o in outs)
+    ):
         return
     vals = [lf.value for lf in leaves]
     if not all(_finite(v) for v in vals):
@@ -627,11 +744,21 @@ def _guard_check(out, instrs, sites, leaves, lshapes, out_slot, fast_flag=None):
         if not _finite(val):
             name = op_name(fn)
             site = sites[i]
-            subtree = _render_instrs(instrs, leaves, out_slot, upto=i, mark=i)
+            subtree = _render_instrs(instrs, leaves, out_slots, upto=i, mark=i)
+            consumers = ""
+            if len(out_slots) > 1:
+                fed = [
+                    k for k, s in enumerate(out_slots) if _reaches(instrs, s, i)
+                ]
+                consumers = (
+                    f"; feeds output(s) {', '.join('%%%d' % out_slots[k] for k in fed)}"
+                    f" (root index {', '.join(str(k) for k in fed)})"
+                    f" of the {len(out_slots)}-output program"
+                )
             err = NonFiniteError(
                 f"non-finite values first produced by op '{name}' "
-                f"(built at {guard.format_site(site)}); offending subtree:\n"
-                f"{subtree}",
+                f"(built at {guard.format_site(site)}){consumers}; "
+                f"offending subtree:\n{subtree}",
                 op=name, site=site, subtree=subtree,
             )
             break
@@ -641,7 +768,7 @@ def _guard_check(out, instrs, sites, leaves, lshapes, out_slot, fast_flag=None):
         # injected corruption).  Still a guard trip: degraded numerics
         # must not pass silently just because they resist op-level
         # attribution.
-        subtree = _render_instrs(instrs, leaves, out_slot)
+        subtree = _render_instrs(instrs, leaves, out_slots)
         err = NonFiniteError(
             "non-finite values in the fused output, but an eager op-by-op "
             "replay of the same chain is finite — fused-program numeric "
@@ -657,18 +784,45 @@ def _guard_check(out, instrs, sites, leaves, lshapes, out_slot, fast_flag=None):
     warnings.warn(str(err), guard.NonFiniteWarning, stacklevel=3)
 
 
-def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
-    """Lower ``expr`` (or fetch the cached executable) and run it.
+def _tuplize(program, with_guard):
+    """Adapt a single-root terminator program (contract: returns ``out`` or
+    ``(out, allfinite)``) to the scheduler's flat-tuple convention
+    (``(out,)`` or ``(out, allfinite)`` flattened)."""
+
+    def wrapped(*vals):
+        out = program(*vals)
+        if with_guard:
+            out, flag = out
+            return (out, flag)
+        return (out,)
+
+    return wrapped
+
+
+def _run_many(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
+    """Lower several DAG roots as ONE multi-output program (or fetch the
+    cached executable) and run it, returning one physical array per root.
+
+    The roots linearize into a single deduplicated instruction list
+    (:func:`_linearize`), so subtrees shared between roots — by node
+    identity or by structural fingerprint — compile and execute exactly
+    once.  The cache key carries the full ``out_slots`` tuple: output
+    arity and the root-set fingerprint are part of the entry, so a
+    two-output program never aliases its single-output prefix.
 
     Failure containment: a fused program that fails to compile or execute
     falls back to per-op eager evaluation of the same DAG (counted under
     ``compile_error``/``exec_error`` in :func:`cache_stats`); with the
     guard on, a materialized chain whose finite inputs produced NaN/Inf
-    raises :class:`NonFiniteError` via an attributing eager replay."""
-    instrs, sites, leaves, out_slot = _linearize(expr)
+    raises :class:`NonFiniteError` via an attributing eager replay — the
+    folded fast-finite flag joins the program's output tuple instead of
+    forcing a second dispatch."""
+    instrs, sites, leaves, out_slots = _linearize(*exprs)
     vals = [lf.value for lf in leaves]
     lshapes = tuple(tuple(lf.lshape) for lf in leaves)
-    target = comm.sharding(split, len(gshape))
+    gshapes = tuple(tuple(g) for g in gshapes)
+    splits = tuple(splits)
+    targets = tuple(comm.sharding(s, len(g)) for s, g in zip(splits, gshapes))
     sig = tuple(
         (tuple(v.shape), str(v.dtype), getattr(v, "sharding", None))
         for v in vals
@@ -681,28 +835,40 @@ def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
     guard_on = guard.enabled()
     fold = False
     if guard_on:
-        n_out = 1
-        for d in gshape:
-            n_out *= int(d)
-        fold = n_out > _GUARD_FOLD_MIN_ELEMS
+        n_max = 0
+        for g in gshapes:
+            n = 1
+            for d in g:
+                n *= int(d)
+            n_max = max(n_max, n)
+        fold = n_max > _GUARD_FOLD_MIN_ELEMS
     key = (
-        instrs, out_slot, lshapes, sig, tuple(gshape), split, target, donate,
+        instrs, out_slots, lshapes, sig, gshapes, splits, targets, donate,
         guard_on, _terminator_salt(),
     )
     flag = None
     entry = _CACHE.get(key)
     if entry is None:
         _STATS["misses"] += 1
+        n_roots = len(out_slots)
+        _ROOTS_PER_PROGRAM[n_roots] = _ROOTS_PER_PROGRAM.get(n_roots, 0) + 1
         try:
             guard.fire("fusion.compile")
-            program = _lower_terminated(
-                instrs, leaves, out_slot, lshapes, tuple(gshape), split,
-                comm, target, fold,
-            )
+            program = None
+            if n_roots == 1:
+                # schedule-controlled engines (overlap.py's ring matmul)
+                # keep their single-root contract; multi-root programs
+                # always take the generic GSPMD build
+                single = _lower_terminated(
+                    instrs, leaves, out_slots[0], lshapes, gshapes[0],
+                    splits[0], comm, targets[0], fold,
+                )
+                if single is not None:
+                    program = _tuplize(single, fold)
             if program is None:
                 program = _build_program(
-                    instrs, out_slot, lshapes, tuple(gshape), split, comm.size,
-                    target, with_guard=fold,
+                    instrs, out_slots, lshapes, gshapes, splits, comm.size,
+                    targets, with_guard=fold,
                 )
             jitted = jax.jit(program, donate_argnums=donate or ())
             # only mesh shardings are recorded for AOT re-lowering (last_hlo):
@@ -717,16 +883,16 @@ def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
                 for s in (getattr(v, "sharding", None),)
             )
             entry = _Entry(jitted, avals)
-            out = entry.jitted(*vals)
+            outs = entry.jitted(*vals)
             if fold:
-                out, flag = out
+                outs, flag = outs[:-1], outs[-1]
         except Exception:
             # trace/lowering/compile/first-run failure: the executable is
             # unusable — do NOT cache it; recompute per-op eagerly
             count_fallback("compile_error")
             flag = None
-            out = _eager_fallback(
-                instrs, vals, lshapes, out_slot, gshape, split, comm, target
+            outs = _eager_fallback(
+                instrs, vals, lshapes, out_slots, gshapes, splits, comm, targets
             )
         else:
             _CACHE[key] = entry
@@ -739,25 +905,31 @@ def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
         _CACHE.move_to_end(key)
         try:
             guard.fire("fusion.exec")
-            out = entry.jitted(*vals)
+            outs = entry.jitted(*vals)
             if fold:
-                out, flag = out
+                outs, flag = outs[:-1], outs[-1]
         except Exception:
             count_fallback("exec_error")
             flag = None
-            out = _eager_fallback(
-                instrs, vals, lshapes, out_slot, gshape, split, comm, target
+            outs = _eager_fallback(
+                instrs, vals, lshapes, out_slots, gshapes, splits, comm, targets
             )
-    fused_out = out
-    out = guard.corrupt("fusion.exec", out)
+    outs = tuple(outs)
+    fused_outs = outs
+    outs = guard.corrupt("fusion.exec", outs)
     if guard_on:
         # an injected corruption replaced the output object: the folded
-        # flag describes the pre-corruption value, so re-check explicitly
+        # flag describes the pre-corruption values, so re-check explicitly
         _guard_check(
-            out, instrs, sites, leaves, lshapes, out_slot,
-            fast_flag=flag if out is fused_out else None,
+            outs, instrs, sites, leaves, lshapes, out_slots,
+            fast_flag=flag if outs is fused_outs else None,
         )
-    return out
+    return outs
+
+
+def _run(expr: Expr, gshape, split, comm, donate: Tuple[int, ...] = ()):
+    """Single-root :func:`_run_many` (the ``.larray`` boundary)."""
+    return _run_many((expr,), (gshape,), (split,), comm, donate)[0]
 
 
 # ----------------------------------------------------------- lazy DNDarray
@@ -820,7 +992,145 @@ def defer(expr: Expr, gshape, dtype, split, device, comm) -> LazyDNDarray:
     )
 
 
-def materialize(x: DNDarray) -> DNDarray:
-    """Force a (possibly lazy) DNDarray to its concrete physical payload."""
-    x.parray  # property read funnels through __getattr__ when pending
-    return x
+def materialize_all(*arrays):
+    """Materialize several (possibly lazy) DNDarrays as ONE fused program.
+
+    All still-pending roots that share a mesh lower together through
+    :func:`_run_many`: subtrees shared between the roots (by node identity
+    or structural fingerprint) compile and execute exactly once, and the
+    whole batch is a single compile-cache entry / single XLA dispatch.
+    Already-materialized (or eager) arrays pass through untouched; roots
+    on different meshes are grouped per mesh.  Returns ``arrays`` as a
+    tuple, every element now physical.
+    """
+    # DNDarray.__eq__ is elementwise — membership tests must use id()
+    pending = []
+    seen = set()
+    for x in arrays:
+        if (
+            isinstance(x, LazyDNDarray)
+            and "_DNDarray__array" not in x.__dict__
+            and id(x) not in seen
+        ):
+            seen.add(id(x))
+            pending.append(x)
+    while pending:
+        head = pending[0]
+        group = [
+            x for x in pending
+            if x.comm is head.comm or x.comm.mesh == head.comm.mesh
+        ]
+        gids = {id(x) for x in group}
+        pending = [x for x in pending if id(x) not in gids]
+        if len(group) == 1:
+            group[0].parray  # single root: the ordinary __getattr__ path
+            continue
+        exprs = tuple(x._expr for x in group)
+        outs = _run_many(
+            exprs,
+            tuple(x.gshape for x in group),
+            tuple(x.split for x in group),
+            head.comm,
+        )
+        for x, value in zip(group, outs):
+            expr = x._expr
+            expr.leafify(value, x.gshape)
+            _pin(expr, value)
+            object.__setattr__(x, "_DNDarray__array", value)
+            object.__setattr__(x, "_expr", None)
+    for x in arrays:
+        x.parray  # eager handles are no-ops; duplicates already leafified
+    return tuple(arrays)
+
+
+def materialize(*arrays):
+    """Force one or more (possibly lazy) DNDarrays to physical payloads.
+
+    ``materialize(x)`` keeps the original single-array contract and
+    returns ``x`` itself.  ``materialize(a, b, ...)`` batches all pending
+    roots into ONE multi-output fused executable (shared subtrees
+    deduplicated — see :func:`materialize_all`) and returns the arrays as
+    a tuple.  Exported as ``heat_tpu.materialize``.
+    """
+    if not arrays:
+        raise TypeError("materialize() requires at least one array")
+    if len(arrays) == 1:
+        arrays[0].parray  # property read funnels through __getattr__
+        return arrays[0]
+    return materialize_all(*arrays)
+
+
+# ------------------------------------------- split-boundary terminators
+
+# Lowerers consulted when a lazy chain terminates at a split CHANGE (a
+# resplit / split-crossing reshape boundary) rather than at a plain read.
+# Contract: lowerer(instrs, leaves, out_slot, lshapes, gshape, old_split,
+# new_split, comm, tile_bytes) -> physical array in the NEW split, or
+# None to decline.  Registered lazily by parallel/transport.py so core
+# keeps zero imports from parallel at module load.
+_SPLIT_TERMINATORS: "list[Callable]" = []
+
+
+def register_split_terminator(lowerer: Callable) -> Callable:
+    """Register a split-boundary lowerer (see ``_SPLIT_TERMINATORS``)."""
+    _SPLIT_TERMINATORS.append(lowerer)
+    return lowerer
+
+
+_SPLIT_LOWERERS_READY = False
+
+
+def _ensure_split_lowerers() -> None:
+    global _SPLIT_LOWERERS_READY
+    if _SPLIT_LOWERERS_READY:
+        return
+    from ..parallel import transport
+
+    transport.ensure_fused_tail_registered()
+    _SPLIT_LOWERERS_READY = True
+
+
+def materialize_resplit(x, new_split, tile_bytes=None):
+    """Lower a pending chain DIRECTLY into the new split's transport loop.
+
+    When ``x`` is a still-pending :class:`LazyDNDarray` whose elementwise
+    tail a registered split terminator can fuse into the per-tile
+    all-to-all (compute on tile *k* overlapping the collective for tile
+    *k+1*), returns the physical array already in ``new_split`` — no
+    separate pre-pass materialization.  Returns None when the chain is
+    not pending, the boundary is not a real split change, or every
+    lowerer declines; callers then fall back to materialize-then-resplit.
+
+    ``x`` itself stays pending: the fused output is in the NEW layout,
+    while other consumers of the chain still need the old-split value.
+    """
+    if not _ENABLED:
+        return None
+    if not (
+        isinstance(x, LazyDNDarray) and "_DNDarray__array" not in x.__dict__
+    ):
+        return None
+    if new_split is None or x.split is None or new_split == x.split:
+        return None
+    _ensure_split_lowerers()
+    expr = x._expr
+    if expr is None:
+        return None
+    instrs, sites, leaves, out_slots = _linearize(expr)
+    lshapes = tuple(tuple(lf.lshape) for lf in leaves)
+    for lowerer in _SPLIT_TERMINATORS:
+        try:
+            out = lowerer(
+                instrs, leaves, out_slots[0], lshapes, tuple(x.gshape),
+                x.split, int(new_split), x.comm, tile_bytes,
+            )
+        except Exception:
+            out = None
+        if out is not None:
+            if guard.enabled():
+                _guard_check(
+                    (out,), instrs, sites, leaves, lshapes, out_slots,
+                    fast_flag=None,
+                )
+            return out
+    return None

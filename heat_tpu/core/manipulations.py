@@ -22,7 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import factories, sanitation, stride_tricks, types
+from . import factories, fusion, sanitation, stride_tricks, types
 from .dndarray import DNDarray, _ensure_split, _to_physical
 from ..parallel import transport
 
@@ -333,9 +333,27 @@ def reshape(a: DNDarray, *shape, new_split=None) -> DNDarray:
         if ns is not None and transport.reshape_applicable(
             a.shape, a.split, gout, ns, a.comm
         ):
-            phys = transport.tiled_reshape(
-                a.parray, a.shape, a.split, gout, ns, a.comm
-            )
+            phys = None
+            if a.split != 0:
+                # split-crossing reshape stages through split 0: a pending
+                # lazy chain can fuse its elementwise tail into that first
+                # resplit's tile loop, and the fused output (owned solely
+                # by this call) is donated to the remaining stages
+                preserving = (
+                    transport._prefix_prod(a.shape, a.split)
+                    == transport._prefix_prod(gout, ns)
+                    and int(a.shape[a.split]) == int(gout[ns])
+                )
+                if not preserving:
+                    fused0 = fusion.materialize_resplit(a, 0)
+                    if fused0 is not None:
+                        phys = transport.tiled_reshape(
+                            fused0, a.shape, 0, gout, ns, a.comm, donate=True
+                        )
+            if phys is None:
+                phys = transport.tiled_reshape(
+                    a.parray, a.shape, a.split, gout, ns, a.comm
+                )
             return DNDarray(
                 phys, gout, a.dtype, ns, a.device, a.comm
             )
@@ -355,9 +373,14 @@ def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
     if axis == arr.split:
         return arr
     if transport.resplit_applicable(arr.shape, arr.split, axis, arr.comm):
-        physical = transport.tiled_resplit(
-            arr.parray, arr.shape, arr.split, axis, arr.comm, donate=False
-        )
+        # a still-pending lazy chain lowers its elementwise tail directly
+        # into the per-tile all_to_all loop (no old-split materialization);
+        # `arr` itself stays pending for any other consumers
+        physical = fusion.materialize_resplit(arr, axis)
+        if physical is None:
+            physical = transport.tiled_resplit(
+                arr.parray, arr.shape, arr.split, axis, arr.comm, donate=False
+            )
     else:
         physical = _to_physical(arr.larray, arr.shape, axis, arr.comm)
     return DNDarray(physical, arr.shape, arr.dtype, axis, arr.device, arr.comm)

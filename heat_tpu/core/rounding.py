@@ -88,3 +88,13 @@ def trunc(x, out=None) -> DNDarray:
 DNDarray.clip = lambda self, min=None, max=None, out=None: clip(self, min, max, out)
 DNDarray.round = lambda self, decimals=0, out=None, dtype=None: round(self, decimals, out, dtype)
 DNDarray.modf = lambda self, out=None: modf(self, out)
+
+# display names + kinds for the fusion engine's op table (see
+# exponential.py — same shape-preserving "elementwise" contract)
+from . import fusion as _fusion
+
+for _fn, _name in [
+    (jnp.abs, "abs"), (jnp.fabs, "fabs"), (jnp.ceil, "ceil"),
+    (jnp.floor, "floor"), (jnp.trunc, "trunc"), (jnp.sign, "sign"),
+]:
+    _fusion.register_op(_fn, _name, kind="elementwise")

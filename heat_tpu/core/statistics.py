@@ -214,17 +214,16 @@ def skew(x, axis=None, unbiased: bool = True) -> DNDarray:
     return _moment_stat(x, axis, order=3, unbiased=unbiased)
 
 
-def _moment_stat(x, axis, order: int, unbiased: bool, fischer: bool = True) -> DNDarray:
-    sanitation.sanitize_in(x)
-    arr = x.larray
-    if not jnp.issubdtype(arr.dtype, jnp.inexact):
-        arr = arr.astype(jnp.float32)
-    axis_s = sanitize_axis(x.shape, axis)
-    n = x.size if axis_s is None else x.shape[axis_s]
-    mu = jnp.mean(arr, axis=axis_s, keepdims=True)
-    centered = arr - mu
-    m2 = jnp.mean(centered**2, axis=axis_s)
-    mk = jnp.mean(centered**order, axis=axis_s)
+def _moment_kernel(t, axis=None, order=3, n=1, unbiased=True, fischer=True):
+    """Standardized central moment of order 3 (skew) / 4 (kurtosis) with
+    the reference's bias corrections — all host-static decisions (order,
+    sample count, bias mode) ride as kwargs so the singleton function
+    object fingerprints stably in the fusion op table."""
+    t = _float_acc(t)
+    mu = jnp.mean(t, axis=axis, keepdims=True)
+    centered = t - mu
+    m2 = jnp.mean(centered**2, axis=axis)
+    mk = jnp.mean(centered**order, axis=axis)
     if order == 3:
         g = mk / (m2**1.5)
         if unbiased and n > 2:
@@ -235,13 +234,48 @@ def _moment_stat(x, axis, order: int, unbiased: bool, fischer: bool = True) -> D
             g = ((n**2 - 1) * g - 3 * (n - 1) ** 2) / ((n - 2) * (n - 3)) + 3
         if fischer:
             g = g - 3
-    result = jnp.asarray(g)
+    return jnp.asarray(g)
+
+
+_operations.fusion.register_op(_moment_kernel, "moment", kind="composite")
+
+
+def _moment_stat(x, axis, order: int, unbiased: bool, fischer: bool = True) -> DNDarray:
+    """Shared skew/kurtosis entry.  Under fusion the whole multi-pass
+    moment computation (mean, centering, two powers, two means, bias
+    correction) joins the lazy DAG as ONE composite node — so
+    ``materialize(skew_chain, kurtosis_chain)`` shares the input leaf and
+    compiles a single program, and a chain feeding the moment fuses
+    through instead of materializing first."""
+    sanitation.sanitize_in(x)
+    fusion = _operations.fusion
+    axis_s = sanitize_axis(x.shape, axis)
+    n = x.size if axis_s is None else x.shape[axis_s]
     split = x.split
     if split is not None:
         if axis_s is None or split == axis_s:
             split = None
         elif axis_s < split:
             split -= 1
+    if fusion.enabled():
+        try:
+            nx = _operations._lazy_operand(x, x.comm)
+            res = fusion.node(
+                _moment_kernel, (nx,), axis=axis_s, order=int(order),
+                n=int(n), unbiased=bool(unbiased), fischer=bool(fischer),
+            )
+            out_split = None if len(res.aval.shape) == 0 else split
+            return fusion.defer(
+                res, tuple(res.aval.shape),
+                types.canonical_heat_type(res.aval.dtype),
+                out_split, x.device, x.comm,
+            )
+        except fusion.Unfusable:
+            fusion.count_fallback()
+    result = _moment_kernel(
+        x.larray, axis=axis_s, order=int(order), n=int(n),
+        unbiased=bool(unbiased), fischer=bool(fischer),
+    )
     if result.ndim == 0:
         split = None
     return _ensure_split(

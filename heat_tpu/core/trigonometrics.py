@@ -107,3 +107,17 @@ def sinc(x, out=None) -> DNDarray:
 
 def tanh(x, out=None) -> DNDarray:
     return _operations._local_op(jnp.tanh, x, out=out)
+
+# display names + kinds for the fusion engine's op table (see
+# exponential.py — same shape-preserving "elementwise" contract)
+from . import fusion as _fusion
+
+for _fn, _name in [
+    (jnp.sin, "sin"), (jnp.cos, "cos"), (jnp.tan, "tan"),
+    (jnp.sinh, "sinh"), (jnp.cosh, "cosh"), (jnp.tanh, "tanh"),
+    (jnp.arcsin, "arcsin"), (jnp.arccos, "arccos"), (jnp.arctan, "arctan"),
+    (jnp.arcsinh, "arcsinh"), (jnp.arccosh, "arccosh"),
+    (jnp.arctanh, "arctanh"), (jnp.deg2rad, "deg2rad"),
+    (jnp.rad2deg, "rad2deg"), (jnp.sinc, "sinc"),
+]:
+    _fusion.register_op(_fn, _name, kind="elementwise")

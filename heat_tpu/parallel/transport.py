@@ -127,6 +127,9 @@ _STATS = {
     "last_tile_bytes": None,
     # per-kernel retry counts: {"resplit": n, "take": n, "reshape": n}
     "retries_by_kind": {},
+    # split-terminated lazy chains whose elementwise tail lowered INTO the
+    # per-tile resplit loop (no separate pre-pass materialization)
+    "fused_tails": 0,
 }
 
 
@@ -135,7 +138,9 @@ def stats() -> dict:
     halvings that led to a retry), ``oom_exhausted`` (transfers that still
     OOMed at ``TILE_FLOOR_BYTES`` and re-raised), ``last_tile_bytes`` (the
     budget the most recent transfer succeeded at — equal to the configured
-    ``TILE_BYTES`` unless backoff engaged), and ``retries_by_kind``."""
+    ``TILE_BYTES`` unless backoff engaged), ``retries_by_kind``, and
+    ``fused_tails`` (lazy-chain tails fused into the resplit tile loop —
+    each one is a materialization pre-pass that did NOT happen)."""
     out = dict(_STATS)
     out["retries_by_kind"] = dict(_STATS["retries_by_kind"])
     return out
@@ -147,6 +152,7 @@ def reset_stats() -> None:
     _STATS["oom_exhausted"] = 0
     _STATS["last_tile_bytes"] = None
     _STATS["retries_by_kind"] = {}
+    _STATS["fused_tails"] = 0
 
 
 def _is_oom(err: Exception) -> bool:
@@ -459,6 +465,245 @@ def tiled_resplit(
     return _with_oom_backoff("resplit", run, tile_bytes)
 
 
+# ------------------------------------------------- fused elementwise tail
+
+# Op kinds the tile loop can replay per-block: shape-preserving maps whose
+# value at an element depends on that element alone.  Reductions, scans,
+# matmuls and composite kernels carry axis semantics that do not survive
+# the (pa, S, tile_cols) re-view and decline to the pre-pass route.
+_FUSED_TAIL_KINDS = frozenset({"elementwise", "cast", "comparison", "predicate"})
+
+
+def _build_tiled_resplit_fused(
+    mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles,
+    out_slot, instrs, leaf_kinds, out_dtype_str,
+):
+    """:func:`_build_tiled_resplit` with the chain's elementwise tail
+    evaluated inside the tile loop: tile *k*'s compute overlaps the
+    collective for tile *k+1* (same schedule the ring matmul uses for its
+    dots), so the chain output is never materialized in the OLD split.
+
+    ``instrs`` is the fusion engine's deduplicated instruction list; every
+    full-shape leaf arrives in canonical source-split physical layout and
+    is viewed as ``(pa, S, pb)`` exactly like the unfused engine's single
+    operand, scalars broadcast per block.  The chain also runs on the
+    padding lanes and produces garbage there — source-axis pad rows are
+    sliced off after the loop and destination-axis pad columns are
+    re-zeroed, so the output keeps the clean zero-pad physical contract
+    (f(0) != 0 must not leak into the pad)."""
+    S = int(mesh.shape[axis_name])
+    pb = -(-n_b // S)
+    padded_b = n_tiles * tile_cols
+    out_dtype = jnp.dtype(out_dtype_str)
+    # bool has no all_to_all wire format on some backends: ship uint8
+    wire_dtype = jnp.dtype(jnp.uint8) if out_dtype == jnp.dtype(jnp.bool_) else out_dtype
+
+    def local(*leaf_vals):
+        prepped = []
+        pa = 1
+        rest = ()
+        for v, kind in zip(leaf_vals, leaf_kinds):
+            if kind == "scalar":
+                prepped.append(v)
+                continue
+            xv = jnp.moveaxis(v, (sa, sb), (0, 1))
+            nb = xv.shape[1]
+            rest = xv.shape[2:]
+            padw = [(0, 0), (0, S * pb - nb)] + [(0, 0)] * (xv.ndim - 2)
+            xr = jnp.pad(xv, padw).reshape((xv.shape[0], S, pb) + rest)
+            if padded_b != pb:
+                pw = [(0, 0), (0, 0), (0, padded_b - pb)] + [(0, 0)] * len(rest)
+                xr = jnp.pad(xr, pw)
+            pa = xr.shape[0]
+            prepped.append(xr)
+
+        def tile(t, acc):
+            env = {}
+            for s_i, ins in enumerate(instrs):
+                if ins[0] == "L":
+                    blk = prepped[ins[1]]
+                    if leaf_kinds[ins[1]] == "full":
+                        blk = lax.dynamic_slice_in_dim(
+                            blk, t * tile_cols, tile_cols, axis=2
+                        )
+                    env[s_i] = blk
+                else:
+                    _, fn, kw, ch = ins
+                    env[s_i] = fn(*(env[c] for c in ch), **dict(kw))
+            blk = env[out_slot].astype(wire_dtype)
+            got = lax.all_to_all(
+                blk, axis_name, split_axis=1, concat_axis=0, tiled=True
+            )
+            return lax.dynamic_update_slice_in_dim(
+                acc, got.reshape((S * pa, tile_cols) + rest), t * tile_cols, axis=1
+            )
+
+        acc = jnp.zeros((S * pa, padded_b) + rest, wire_dtype)
+        if n_tiles == 1:
+            acc = tile(0, acc)
+        else:
+            acc = lax.fori_loop(0, n_tiles, tile, acc)
+        out = acc[:n_a, :pb]
+        if S * pb != n_b:
+            me = lax.axis_index(axis_name)
+            cols = me * pb + jnp.arange(pb)
+            keep = (cols < n_b).reshape((1, pb) + (1,) * len(rest))
+            out = jnp.where(keep, out, jnp.zeros((), wire_dtype))
+        return jnp.moveaxis(out.astype(out_dtype), (0, 1), (sa, sb))
+
+    in_specs = tuple(
+        _split_spec(axis_name, ndim, sa) if k == "full" else P()
+        for k in leaf_kinds
+    )
+    return shard_map_unchecked(
+        local,
+        mesh,
+        in_specs=in_specs,
+        out_specs=_split_spec(axis_name, ndim, sb),
+    )
+
+
+@lru_cache(maxsize=512)
+def _jit_tiled_resplit_fused(
+    mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles,
+    out_slot, instrs, leaf_kinds, out_dtype_str,
+):
+    # never donating: the leaves belong to still-pending expressions (the
+    # chain may have OTHER consumers that want the old-split value)
+    fn = _build_tiled_resplit_fused(
+        mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles,
+        out_slot, instrs, leaf_kinds, out_dtype_str,
+    )
+    return jax.jit(fn)
+
+
+def _lower_split_tail(
+    instrs, leaves, out_slot, lshapes, gshape, sa, sb, comm, tile_bytes
+):
+    """Split-boundary terminator (``fusion.register_split_terminator``
+    contract): lower a lazy chain that ends at a ``sa -> sb`` resplit
+    directly into the tiled transport loop, returning the physical array
+    already in split ``sb`` — or ``None`` to decline (caller falls back to
+    materialize-then-resplit).
+
+    Accepts exactly the shapes the tile loop can replay: every op is a
+    registered shape-preserving map (``_FUSED_TAIL_KINDS``), every leaf is
+    either the chain's full-shape operand in canonical source-split
+    physical layout or a one-element scalar, and the root is full-shape.
+    Anything else — reductions, ``where=`` masks (their ``jnp.where`` /
+    ``jnp.zeros`` factory nodes are unregistered), broadcast-shaped
+    operands, replicated or foreign-split full leaves — declines."""
+    from ..core import fusion
+
+    gshape = tuple(int(d) for d in gshape)
+    if not resplit_applicable(gshape, sa, sb, comm):
+        return None
+    if instrs[out_slot][0] != "O":
+        return None
+    S = comm.size
+    ndim = len(gshape)
+    n_a, n_b = gshape[sa], gshape[sb]
+    pa = -(-n_a // S)
+    phys_shape = tuple(S * pa if i == sa else gshape[i] for i in range(ndim))
+
+    leaf_kinds = []
+    for lf, lshape in zip(leaves, lshapes):
+        lshape = tuple(int(d) for d in lshape)
+        nelem = 1
+        for d in lshape:
+            nelem *= d
+        if lshape == gshape:
+            if tuple(int(d) for d in lf.value.shape) != phys_shape:
+                return None
+            leaf_kinds.append("full")
+        elif nelem == 1:
+            leaf_kinds.append("scalar")
+        else:
+            return None
+    leaf_kinds = tuple(leaf_kinds)
+
+    avals = []
+    for ins in instrs:
+        if ins[0] == "L":
+            lf = leaves[ins[1]]
+            avals.append(
+                jax.ShapeDtypeStruct(tuple(lshapes[ins[1]]), lf.value.dtype)
+            )
+            continue
+        _, fn, kw, ch = ins
+        meta = fusion._OP_TABLE.get(fn)
+        if meta is None or meta[1] not in _FUSED_TAIL_KINDS:
+            return None
+        child_avals = tuple(avals[c] for c in ch)
+        try:
+            aval = fusion._infer_aval(fn, child_avals, kw)
+        except Exception:
+            return None
+        shp = tuple(int(d) for d in aval.shape)
+        if shp == gshape:
+            # a full-shape op must consume at least one full-shape child:
+            # childless factories (jnp.zeros) have no tiled source view
+            if not any(
+                tuple(int(d) for d in ca.shape) == gshape for ca in child_avals
+            ):
+                return None
+        else:
+            n = 1
+            for d in shp:
+                n *= d
+            if n != 1:
+                return None
+        avals.append(aval)
+    root_aval = avals[out_slot]
+    if tuple(int(d) for d in root_aval.shape) != gshape:
+        return None
+    out_dtype_str = str(root_aval.dtype)
+
+    # one-element leaves broadcast identically at any rank; rank-0 keeps
+    # the per-block broadcast independent of the moveaxis re-view
+    leaf_vals = tuple(
+        lf.value.reshape(()) if kind == "scalar" else lf.value
+        for lf, kind in zip(leaves, leaf_kinds)
+    )
+
+    itemsize = max(int(jnp.dtype(root_aval.dtype).itemsize), 1)
+    rest = 1
+    for d in range(ndim):
+        if d not in (sa, sb):
+            rest *= gshape[d]
+    pb = -(-n_b // S)
+
+    def run(tb):
+        tile_cols, n_tiles = tile_plan(pb, pa * S * rest * itemsize, tb)
+        fn = _jit_tiled_resplit_fused(
+            comm.mesh, comm.split_axis, ndim, int(sa), int(sb), n_a, n_b,
+            tile_cols, n_tiles, int(out_slot), instrs, leaf_kinds,
+            out_dtype_str,
+        )
+        return fn(*leaf_vals)
+
+    out = _with_oom_backoff("resplit", run, tile_bytes)
+    _STATS["fused_tails"] += 1
+    return out
+
+
+_FUSED_TAIL_REGISTERED = False
+
+
+def ensure_fused_tail_registered() -> None:
+    """Idempotently register :func:`_lower_split_tail` with the fusion
+    engine's split-terminator registry (called lazily from
+    ``fusion.materialize_resplit`` so core never imports parallel at
+    module load)."""
+    global _FUSED_TAIL_REGISTERED
+    if _FUSED_TAIL_REGISTERED:
+        return
+    from ..core import fusion
+
+    fusion.register_split_terminator(_lower_split_tail)
+    _FUSED_TAIL_REGISTERED = True
+
+
 # ------------------------------------------------------------------ reshape
 
 
@@ -627,11 +872,14 @@ def tiled_reshape(
     so: int,
     comm,
     tile_bytes: Optional[int] = None,
+    donate: bool = False,
 ) -> jax.Array:
     """Split-crossing reshape ``gin``/split ``si`` → ``gout``/split ``so``
     on physical arrays.  Stages: resplit to split-0, flat rechunk, resplit
-    to ``so`` — the stage intermediates are donated (the caller's input is
-    not).  Callers must check :func:`reshape_applicable` first."""
+    to ``so`` — the stage intermediates are donated; the caller's input is
+    donated only with ``donate=True`` (pass it solely for buffers with no
+    other live reference, e.g. a fused-tail pre-stage output the caller
+    owns).  Callers must check :func:`reshape_applicable` first."""
     S = comm.size
     gin = tuple(int(d) for d in gin)
     gout = tuple(int(d) for d in gout)
@@ -648,11 +896,11 @@ def tiled_reshape(
         return fn(phys)
 
     if si != 0:
-        phys = tiled_resplit(phys, gin, si, 0, comm, donate=False,
+        phys = tiled_resplit(phys, gin, si, 0, comm, donate=donate,
                              tile_bytes=tile_bytes)
         mid_owned = True
     else:
-        mid_owned = False
+        mid_owned = donate
 
     rowsz_in = _prefix_prod(gin, len(gin)) // gin[0]
     rowsz_out = _prefix_prod(gout, len(gout)) // gout[0]

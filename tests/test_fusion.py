@@ -227,6 +227,135 @@ class _MixedSplitLaws:
         self._where_mask_laws(comm)
 
 
+@unittest.skipUnless(fusion.enabled(), "fusion engine disabled (HEAT_TPU_FUSE=off)")
+class TestMultiOutputScheduler(TestCase):
+    """DAG scheduler laws: one executable for several roots, shared
+    subtrees deduplicated (CSE), describe() marks instead of re-printing."""
+
+    def setUp(self):
+        fusion.reset_cache()
+
+    def _cse_law(self, comm):
+        """mean+var of one chain -> 1 miss, 1 executable, shared subtree
+        linearized once (assert via instruction count)."""
+        n = comm.size * 3
+        src = np.linspace(-2.0, 5.0, n, dtype=np.float32)
+        ref = (src - 3.0) * 2.0
+        fusion.reset_cache()
+        x = ht.array(src, split=0, comm=comm)
+        y = (x - 3.0) * 2.0
+        m, v = y.mean(), y.var()
+        ht.materialize(m, v)
+        stats = fusion.cache_stats()
+        self.assertEqual(stats["misses"], 1)
+        self.assertEqual(stats["size"], 1)
+        self.assertGreaterEqual(stats["cse_hits"], 1)
+        self.assertEqual(stats["roots_per_program"], {2: 1})
+        np.testing.assert_allclose(float(m.larray), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(v.larray), ref.var(), rtol=1e-4)
+        # shared-subtree-once, structurally: the y chain contributes its
+        # instructions a single time to the joint program
+        y2 = (x - 3.0) * 2.0
+        instrs, _, _, out_slots = fusion._linearize(
+            y2.mean()._expr, y2.var()._expr
+        )
+        ops = [i for i in instrs if i[0] == "O"]
+        # sub-chain (sub, mul) once + one reduction per root
+        self.assertEqual(len(ops), 4)
+        self.assertEqual(len(out_slots), 2)
+
+    def test_cse_law_mesh1(self):
+        self._cse_law(_mesh(1))
+
+    def test_cse_law_mesh4(self):
+        if len(jax.devices()) < 4:
+            raise unittest.SkipTest("needs a sub-mesh")
+        self._cse_law(_mesh(4))
+
+    def test_cse_law_mesh8(self):
+        if len(jax.devices()) < 8:
+            raise unittest.SkipTest("needs the 8-device mesh")
+        self._cse_law(self.comm)
+
+    def test_structural_cse_merges_identical_subtrees(self):
+        # two chains built separately over the SAME leaf: distinct Expr
+        # objects, one structural fingerprint -> merged, cse_hits counts it
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        a = (x * x).sum()
+        b = (x * x).mean()
+        fusion.reset_cache()
+        ht.materialize(a, b)
+        stats = fusion.cache_stats()
+        self.assertEqual(stats["misses"], 1)
+        self.assertGreaterEqual(stats["cse_hits"], 1)
+        src = np.arange(24, dtype=np.float32)
+        np.testing.assert_allclose(float(a.larray), (src * src).sum(), rtol=1e-5)
+        np.testing.assert_allclose(float(b.larray), (src * src).mean(), rtol=1e-5)
+
+    def test_multi_output_values_match_separate_eager(self):
+        src = np.linspace(0.5, 4.0, 16, dtype=np.float32)
+        with fusion.fuse(False):
+            e = ht.array(src, split=0)
+            ref_m = float((e * 2.0).mean().larray)
+            ref_s = float((e * 2.0).std().larray)
+        x = ht.array(src, split=0)
+        y = x * 2.0
+        m, s = y.mean(), y.std()
+        ht.materialize(m, s)
+        np.testing.assert_allclose(float(m.larray), ref_m, rtol=1e-5)
+        np.testing.assert_allclose(float(s.larray), ref_s, rtol=1e-4)
+
+    def test_materialize_single_keeps_contract(self):
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        y = x + 1.0
+        out = ht.materialize(y)
+        self.assertIs(out, y)
+        self.assert_array_equal(out, np.arange(8, dtype=np.float32) + 1.0)
+
+    def test_materialize_requires_an_array(self):
+        with self.assertRaises(TypeError):
+            ht.materialize()
+
+    def test_materialize_passes_eager_arrays_through(self):
+        x = ht.arange(6, dtype=ht.float32, split=0)
+        with fusion.fuse(False):
+            e = ht.arange(6, dtype=ht.float32, split=0) * 2.0
+        y = x + 1.0
+        got = ht.materialize(y, e)
+        self.assertEqual(len(got), 2)
+        self.assert_array_equal(got[1], np.arange(6, dtype=np.float32) * 2.0)
+
+    def test_second_multi_materialization_hits_cache(self):
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        y = (x - 1.0) * 0.5
+        ht.materialize(y.mean(), y.var())
+        before = fusion.cache_stats()
+        z = ht.arange(24, dtype=ht.float32, split=0)
+        w = (z - 1.0) * 0.5
+        ht.materialize(w.mean(), w.var())
+        after = fusion.cache_stats()
+        self.assertEqual(after["misses"], before["misses"])
+        self.assertEqual(after["hits"], before["hits"] + 1)
+
+    def test_describe_marks_shared_subtrees(self):
+        x = ht.arange(12, dtype=ht.float32, split=0)
+        y = (x - 3.0) * 2.0
+        text = fusion.describe(y.mean(), y.var())
+        # the shared chain renders ONCE, with a ref-mark, and the return
+        # line names both roots
+        self.assertEqual(text.count("mul("), 1)
+        self.assertIn("<<shared x2>>", text)
+        last = text.strip().splitlines()[-1]
+        self.assertTrue(last.startswith("return %"))
+        self.assertIn(",", last)
+
+    def test_describe_single_root_unchanged(self):
+        x = ht.arange(6, dtype=ht.float32, split=0)
+        text = fusion.describe((x + 1.0) * 2.0)
+        self.assertNotIn("<<shared", text)
+        self.assertTrue(text.strip().splitlines()[-1].startswith("return %"))
+
+
 class TestFusionMixedSplitMesh1(_MixedSplitLaws, TestCase):
     def test_laws_mesh1(self):
         self._run_all(_mesh(1))

@@ -136,6 +136,86 @@ class TestNonFiniteProvenance(TestCase):
         self.assertIsNone(ctx.exception.op)
         self.assertEqual(inj.fired, [("nan", "fusion.exec")])
 
+    def test_shared_node_blamed_once_with_both_consumers(self):
+        # a NaN introduced in a node SHARED between two roots of a
+        # multi-output program: exactly one error, one replay, the shared
+        # div blamed once, and the message attributes both consumers
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        bad = (x - x) / (x - x)  # 0/0 -> NaN, shared by both roots
+        a = bad + 1.0
+        b = bad * 2.0
+        with self.assertRaises(fusion.NonFiniteError) as ctx:
+            ht.materialize(a, b)
+        err = ctx.exception
+        self.assertEqual(err.op, "div")
+        self.assertEqual(
+            fusion.cache_stats()["fallback_reasons"]["guard_replay"], 1
+        )
+        # the shared subtree renders once in the provenance dump
+        self.assertEqual(err.subtree.count("div("), 1)
+        self.assertIn("first non-finite", err.subtree)
+        # both roots of the 2-output program are named as consumers
+        self.assertIn("2-output program", str(err))
+        self.assertIn("root index 0, 1", str(err))
+
+    def test_multi_output_warn_mode_warns_once_for_shared_node(self):
+        with guard.guarded("warn"):
+            x = ht.arange(12, dtype=ht.float32, split=0)
+            bad = (x - x) / (x - x)
+            a = bad + 1.0
+            b = bad * 2.0
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                ht.materialize(a, b)
+        msgs = [
+            str(w.message)
+            for w in caught
+            if issubclass(w.category, guard.NonFiniteWarning)
+        ]
+        self.assertEqual(len(msgs), 1)
+        self.assertIn("'div'", msgs[0])
+        # values still delivered on both outputs
+        self.assertTrue(np.isnan(np.asarray(a.larray)).all())
+        self.assertTrue(np.isnan(np.asarray(b.larray)).all())
+
+    def test_multi_output_guard_off_materializes_silently(self):
+        with guard.guarded(False):
+            x = ht.arange(8, dtype=ht.float32, split=0)
+            bad = (x - x) / (x - x)
+            a, b = ht.materialize(bad + 1.0, bad * 2.0)
+        self.assertTrue(np.isnan(np.asarray(a.larray)).all())
+        self.assertTrue(np.isnan(np.asarray(b.larray)).all())
+        self.assertEqual(
+            fusion.cache_stats()["fallback_reasons"]["guard_replay"], 0
+        )
+
+    def test_multi_output_injected_corruption_unattributed(self):
+        inj = fault.FaultInjector(seed=0).nan_in("fusion.exec", times=1)
+        with fault.injected(inj):
+            x = ht.arange(8, dtype=ht.float32, split=0)
+            with self.assertRaises(fusion.NonFiniteError) as ctx:
+                ht.materialize(x + 1.0, x * 2.0)
+        self.assertIsNone(ctx.exception.op)
+        self.assertEqual(inj.fired, [("nan", "fusion.exec")])
+
+    def test_multi_output_exec_error_falls_back_to_eager(self):
+        # prime the 2-output entry, then fail its SECOND execution
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        y = x * 2.0
+        ht.materialize(y.mean(), y.var())
+        inj = fault.FaultInjector().error_in("fusion.exec", times=1)
+        with fault.injected(inj):
+            z = ht.arange(16, dtype=ht.float32, split=0)
+            w = z * 2.0
+            m, v = w.mean(), w.var()
+            ht.materialize(m, v)
+        src = np.arange(16, dtype=np.float32) * 2.0
+        np.testing.assert_allclose(float(m.larray), src.mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(v.larray), src.var(), rtol=1e-4)
+        self.assertEqual(
+            fusion.cache_stats()["fallback_reasons"]["exec_error"], 1
+        )
+
 
 @unittest.skipUnless(fusion.enabled(), "fusion engine disabled (HEAT_TPU_FUSE=off)")
 class TestFusionFallback(TestCase):

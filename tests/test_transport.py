@@ -253,5 +253,156 @@ class TestResplitConsumers(TestCase):
         self.assertTrue(np.array_equal(b.numpy(), x))
 
 
+class TestFusedSplitTail(TestCase):
+    """Split-change-terminated lazy chains lower their elementwise tail
+    INTO the per-tile resplit loop: no old-split materialization pre-pass
+    (fusion misses stay 0, transport counts a fused tail), values equal to
+    eager resplit-after-materialize — including under OOM backoff."""
+
+    def setUp(self):
+        from heat_tpu.core import fusion
+
+        if not fusion.enabled():
+            raise unittest.SkipTest("fusion engine disabled")
+        fusion.reset_cache()
+        transport.reset_stats()
+
+    def _mesh(self, n):
+        from heat_tpu.parallel.mesh import local_mesh
+
+        return local_mesh(n)
+
+    def _equality_law(self, comm):
+        from heat_tpu.core import fusion
+
+        rng = np.random.default_rng(11)
+        src = rng.standard_normal((13, 10)).astype(np.float32)
+        with fusion.fuse(False):
+            e = ht.array(src, split=0, comm=comm)
+            ref = np.asarray((ht.exp(e * 0.1) - 1.0).resplit(1).larray)
+        fusion.reset_cache()
+        transport.reset_stats()
+        x = ht.array(src, split=0, comm=comm)
+        out = (ht.exp(x * 0.1) - 1.0).resplit(1)
+        got = np.asarray(out.larray)
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+        self.assertEqual(out.split, 1)
+        # the law: the chain never materialized in the OLD split — zero
+        # fused-engine programs ran, the tail went through the tile loop
+        self.assertEqual(fusion.cache_stats()["misses"], 0)
+        self.assertGreaterEqual(transport.stats()["fused_tails"], 1)
+        # physical pad contract survives f(0) != 0 tails: pad lanes re-zeroed
+        pb = -(-src.shape[1] // comm.size)
+        phys = np.asarray(out.parray)
+        self.assertTrue((phys[:, src.shape[1]:] == 0).all())
+        self.assertEqual(phys.shape[1], pb * comm.size)
+
+    def test_equality_law_mesh4(self):
+        if len(jax.devices()) < 4:
+            raise unittest.SkipTest("needs a sub-mesh")
+        self._equality_law(self._mesh(4))
+
+    def test_equality_law_mesh8(self):
+        if len(jax.devices()) < 8:
+            raise unittest.SkipTest("needs the 8-device mesh")
+        self._equality_law(self.comm)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_oom_backoff_halves_and_matches(self):
+        from heat_tpu.core import fusion
+        from heat_tpu.utils import fault
+
+        src = np.arange(16 * 24, dtype=np.float32).reshape(16, 24)
+        with fusion.fuse(False):
+            ref = np.asarray(
+                ((ht.array(src, split=0) * 2.0) + 1.0).resplit(1).larray
+            )
+        fusion.reset_cache()
+        transport.reset_stats()
+        inj = fault.FaultInjector(seed=0).oom_in("transport.resplit", times=1)
+        with fault.injected(inj):
+            got = np.asarray(
+                ((ht.array(src, split=0) * 2.0) + 1.0).resplit(1).larray
+            )
+        np.testing.assert_array_equal(got, ref)
+        stats = transport.stats()
+        self.assertEqual(inj.fired, [("oom", "transport.resplit")])
+        self.assertEqual(stats["oom_retries"], 1)
+        self.assertEqual(stats["last_tile_bytes"], transport.TILE_BYTES // 2)
+        self.assertGreaterEqual(stats["fused_tails"], 1)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_inplace_resplit_fuses_and_matches(self):
+        from heat_tpu.core import fusion
+
+        src = np.arange(12 * 18, dtype=np.float32).reshape(12, 18)
+        with fusion.fuse(False):
+            ref = np.asarray(
+                ht.sqrt(ht.array(src, split=0) + 1.0).resplit(1).larray
+            )
+        fusion.reset_cache()
+        transport.reset_stats()
+        y = ht.sqrt(ht.array(src, split=0) + 1.0)
+        y.resplit_(1)
+        np.testing.assert_allclose(np.asarray(y.larray), ref, rtol=1e-6)
+        self.assertEqual(y.split, 1)
+        self.assertEqual(fusion.cache_stats()["misses"], 0)
+        self.assertGreaterEqual(transport.stats()["fused_tails"], 1)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_shared_chain_keeps_old_split_consumers_correct(self):
+        # the resplit consumes the chain WITHOUT leafifying it: another
+        # consumer still materializes the old-split value correctly
+        from heat_tpu.core import fusion
+
+        src = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+        y = ht.array(src, split=0) * 3.0
+        moved = y.resplit(1)
+        self.assertGreaterEqual(transport.stats()["fused_tails"], 1)
+        np.testing.assert_array_equal(np.asarray(moved.larray), src * 3.0)
+        # y itself still pending, still split 0, still correct
+        np.testing.assert_array_equal(np.asarray(y.larray), src * 3.0)
+        self.assertEqual(y.split, 0)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_reduction_tail_declines_to_prepass(self):
+        # a chain ending in a reduction cannot replay per tile: it must
+        # take the ordinary materialize-then-resplit route and stay correct
+        from heat_tpu.core import fusion
+
+        src = np.arange(12 * 10, dtype=np.float32).reshape(12, 10)
+        y = (ht.array(src, split=0) * 2.0).sum(axis=1, keepdims=True)
+        self.assertEqual(y.split, 0)
+        z = y.resplit(1)
+        got = np.asarray(z.larray)
+        np.testing.assert_allclose(
+            got, (src * 2.0).sum(axis=1, keepdims=True), rtol=1e-5
+        )
+        self.assertEqual(transport.stats()["fused_tails"], 0)
+        self.assertGreaterEqual(fusion.cache_stats()["misses"], 1)
+
+    @unittest.skipIf(len(jax.devices()) < 8, "needs the 8-device mesh")
+    def test_split_crossing_reshape_fuses_first_stage(self):
+        from heat_tpu.core import fusion
+
+        src = np.arange(16 * 12, dtype=np.float32).reshape(16, 12)
+        with fusion.fuse(False):
+            ref = np.asarray(
+                ht.reshape(
+                    ht.array(src, split=1) * 3.0, (12, 16), new_split=0
+                ).larray
+            )
+        fusion.reset_cache()
+        transport.reset_stats()
+        got = np.asarray(
+            ht.reshape(
+                ht.array(src, split=1) * 3.0, (12, 16), new_split=0
+            ).larray
+        )
+        np.testing.assert_array_equal(got, ref)
+        self.assertEqual(fusion.cache_stats()["misses"], 0)
+        self.assertGreaterEqual(transport.stats()["fused_tails"], 1)
+
+
 if __name__ == "__main__":
     unittest.main()
