@@ -16,6 +16,7 @@ import manipulations
 import nn
 import regression
 
+from heat_tpu.core import telemetry as _telemetry
 from heat_tpu.utils import monitor as _monitor
 
 
@@ -77,6 +78,12 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="write suite JSON to this path")
     ap.add_argument(
+        "--prom",
+        default=None,
+        help="after the run, write telemetry.export_prometheus() (every "
+             "fusion/transport/overlap counter as a gauge) to this path",
+    )
+    ap.add_argument(
         "--only",
         default=None,
         help="comma-separated subset: linalg,cluster,manipulations,nn,regression,fusion",
@@ -112,4 +119,7 @@ if __name__ == "__main__":
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(doc, fh, indent=1)
+    if args.prom:
+        with open(args.prom, "w") as fh:
+            fh.write(_telemetry.export_prometheus())
     sys.exit(0)

@@ -33,6 +33,7 @@ from .core import (
     signal,
     statistics,
     stride_tricks,
+    telemetry,
     tiling,
     trigonometrics,
     types,

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dndarray import DNDarray
-from ..core import types
+from ..core import telemetry, types
 from ..ops.cdist import cdist as ops_cdist
 from ..spatial import distance
 from ._kcluster import _KCluster
@@ -455,6 +455,7 @@ class KMeans(_KCluster):
             None, x.device, x.comm,
         )
 
+    @telemetry.span("kmeans.fit")
     def fit(self, x) -> "KMeans":
         """Lloyd iterations until centroid shift < tol (reference:
         kmeans.py:102-139).  Also accepts :class:`packing.PackedSamples`

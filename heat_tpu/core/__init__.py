@@ -37,6 +37,7 @@ from .dndarray import *
 from . import factories
 from .factories import *
 from . import _operations
+from . import telemetry
 from . import fusion
 from .fusion import materialize, materialize_all
 from . import sanitation

@@ -49,6 +49,7 @@ excluded from the compile-cache key, so guarding adds zero retraces.
 from __future__ import annotations
 
 import os
+import time
 import warnings
 import weakref
 from collections import OrderedDict
@@ -60,7 +61,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import guard, types
+from . import guard, telemetry, types
 from .dndarray import DNDarray, _physical_dim
 from .guard import NonFiniteError
 
@@ -538,31 +539,49 @@ def _lower_terminated(instrs, leaves, out_slot, lshapes, gshape, split, comm,
 # ------------------------------------------------------------ compile cache
 
 class _Entry:
-    __slots__ = ("jitted", "avals", "hits")
+    __slots__ = ("jitted", "avals", "hits", "fp")
 
-    def __init__(self, jitted, avals):
+    def __init__(self, jitted, avals, fp=None):
         self.jitted = jitted
         self.avals = avals
         self.hits = 0
+        self.fp = fp  # telemetry ledger fingerprint (None below counters)
 
 
 _CACHE: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _CACHE_MAX = int(os.environ.get("HEAT_TPU_FUSE_CACHE_SIZE", "4096"))
-_STATS = {"hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0, "cse_hits": 0}
-# output-arity histogram of compiled programs: {n_roots: misses at that
-# arity}.  A serving steady state shows this frozen; a growing multi-root
-# bucket on repeated materialize_all() calls is a retrace regression.
-_ROOTS_PER_PROGRAM: "dict[int, int]" = {}
-# per-reason breakdown of the `fallbacks` total:
-#   unfusable     — op declined to enter the DAG (built eagerly instead)
-#   compile_error — fused program failed to trace/compile/first-run;
-#                   re-executed per-op eagerly with identical semantics
-#   exec_error    — cached executable failed at run time; same recovery
-#   guard_replay  — non-finite guard replayed the chain op-by-op to
-#                   attribute the first NaN/Inf producer
-_FALLBACK_REASONS = {
-    "unfusable": 0, "compile_error": 0, "exec_error": 0, "guard_replay": 0,
-}
+# All counters live in ONE telemetry group; the registry owns the reset
+# contract (a counter added to the defaults below resets/exports/snapshots
+# with no second bookkeeping site).  Notable members:
+#   roots_per_program — output-arity histogram of compiled programs
+#                       ({n_roots: misses at that arity}).  A serving
+#                       steady state shows this frozen; a growing
+#                       multi-root bucket on repeated materialize_all()
+#                       calls is a retrace regression.
+#   fallback_reasons  — per-reason breakdown of the `fallbacks` total:
+#     unfusable     — op declined to enter the DAG (built eagerly instead)
+#     compile_error — fused program failed to trace/compile/first-run;
+#                     re-executed per-op eagerly with identical semantics
+#     exec_error    — cached executable failed at run time; same recovery
+#     guard_replay  — non-finite guard replayed the chain op-by-op to
+#                     attribute the first NaN/Inf producer
+_STATS = telemetry.register_group(
+    "fusion",
+    {
+        "hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0,
+        "cse_hits": 0,
+        "fallback_reasons": {
+            "unfusable": 0, "compile_error": 0, "exec_error": 0,
+            "guard_replay": 0,
+        },
+        "roots_per_program": {},
+    },
+    extra=lambda: {"size": len(_CACHE)},
+)
+# hot-path aliases into the group (reset_group restores nested dicts in
+# place, so these never dangle)
+_FALLBACK_REASONS = _STATS["fallback_reasons"]
+_ROOTS_PER_PROGRAM = _STATS["roots_per_program"]
 
 
 def cache_stats() -> dict:
@@ -585,28 +604,29 @@ def cache_stats() -> dict:
     compiled programs (``{1: single-root misses, 2: two-output misses,
     ...}``): `materialize_all` traffic shows up as multi-root buckets, and
     a bucket that keeps growing on repeated same-shape calls is a
-    multi-output retrace regression."""
-    return {
-        "size": len(_CACHE),
-        **_STATS,
-        "fallback_reasons": dict(_FALLBACK_REASONS),
-        "roots_per_program": dict(_ROOTS_PER_PROGRAM),
-    }
+    multi-output retrace regression.
+
+    Thin shim over ``telemetry.snapshot_group("fusion")`` — the same
+    counters appear in ``ht.telemetry.snapshot()`` and the Prometheus
+    export."""
+    return telemetry.snapshot_group("fusion")
 
 
 def reset_cache() -> None:
-    """Drop all executables and zero the counters (tests/benchmarks)."""
+    """Drop all executables and zero the counters (tests/benchmarks).
+    Counter reset is registry-managed (``telemetry.reset_group``)."""
     _CACHE.clear()
-    for k in _STATS:
-        _STATS[k] = 0
-    for k in _FALLBACK_REASONS:
-        _FALLBACK_REASONS[k] = 0
-    _ROOTS_PER_PROGRAM.clear()
+    telemetry.reset_group("fusion")
 
 
 def count_fallback(reason: str = "unfusable") -> None:
     _STATS["fallbacks"] += 1
     _FALLBACK_REASONS[reason] = _FALLBACK_REASONS.get(reason, 0) + 1
+    telemetry.record_event("fallback", reason=reason)
+    if reason == "exec_error":
+        # a cached executable dying at run time is the flight recorder's
+        # flagship postmortem case: dump the trail before degrading
+        telemetry.postmortem("exec_error_fallback")
 
 
 def last_hlo() -> Optional[str]:
@@ -776,12 +796,25 @@ def _guard_check(outs, instrs, sites, leaves, lshapes, out_slots, fast_flag=None
             f"{subtree}",
             op=None, site=None, subtree=subtree,
         )
+    eid = telemetry.record_event(
+        "guard_blame",
+        op=err.op,
+        site=guard.format_site(err.site) if err.site else None,
+        n_roots=len(out_slots),
+        strict=guard.strict(),
+    )
+    err.event_id = eid
     if guard.strict():
+        telemetry.postmortem("guard_raise")
         raise err
     # default warn mode: NumPy's own contract for sqrt(-1)/log(0)-class
     # results is a RuntimeWarning, not an exception — keep parity, but
-    # with chain-aware attribution attached
-    warnings.warn(str(err), guard.NonFiniteWarning, stacklevel=3)
+    # with chain-aware attribution attached.  Warning is constructed as an
+    # INSTANCE so the blame event id survives onto it (warning → event
+    # correlation for tests and postmortems).
+    w = guard.NonFiniteWarning(str(err))
+    w.event_id = eid
+    warnings.warn(w, stacklevel=3)
 
 
 def _tuplize(program, with_guard):
@@ -799,7 +832,90 @@ def _tuplize(program, with_guard):
     return wrapped
 
 
+def _program_fingerprint(instrs, out_slots) -> str:
+    """Stable short digest of the program TOPOLOGY for the telemetry
+    ledger: registered display names (not function reprs, which carry
+    object addresses), static kwargs, child slots, and the root set.
+    Distinct from the compile-cache key on purpose — the ledger
+    identifies a program shape across meshes and dtypes."""
+    parts = []
+    for ins in instrs:
+        if ins[0] == "L":
+            parts.append(f"L{ins[1]}")
+        else:
+            parts.append(f"{op_name(ins[1])}{ins[2] or ()}>{ins[3]}")
+    parts.append(f"->{out_slots}")
+    return telemetry.fingerprint(parts)
+
+
+def _estimate_cost(instrs, leaves, lshapes, out_slots):
+    """Walk the linearized DAG once and estimate ``(ops, flops,
+    hbm_bytes)`` for the telemetry cost ledger.
+
+    FLOPs per op by registered kind: elementwise/cast/comparison/
+    predicate count one per OUTPUT element; reduction/composite/scan one
+    per INPUT element; matmul counts ``2·m·k·n`` from its 2-D operand
+    avals — the same operand accounting the overlap dispatcher's
+    bytes-per-step cost model keys on.  HBM bytes are the mandatory
+    traffic floor: each unique leaf read once plus each root written once
+    (fused intermediates never round-trip — that is the point of the
+    engine).  Avals re-derive through the memoized :func:`_infer_aval`,
+    so a repeat walk of a known topology is dict lookups."""
+
+    def _nelems(shape):
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n
+
+    avals = []
+    n_ops = 0
+    flops = 0.0
+    for ins in instrs:
+        if ins[0] == "L":
+            lf = leaves[ins[1]]
+            avals.append(
+                jax.ShapeDtypeStruct(tuple(lshapes[ins[1]]), lf.value.dtype)
+            )
+            continue
+        _, fn, kw, ch = ins
+        child = tuple(avals[c] for c in ch)
+        out = _infer_aval(fn, child, kw)
+        avals.append(out)
+        n_ops += 1
+        kind = _OP_TABLE.get(fn, (None, "elementwise"))[1]
+        if (
+            kind == "matmul"
+            and len(child) >= 2
+            and len(child[0].shape) == 2
+            and len(child[1].shape) == 2
+        ):
+            (m, k), n = child[0].shape, child[1].shape[-1]
+            flops += 2.0 * int(m) * int(k) * int(n)
+        elif kind in ("reduction", "composite", "scan"):
+            flops += float(sum(_nelems(a.shape) for a in child))
+        else:  # elementwise / cast / comparison / predicate / unregistered
+            flops += float(_nelems(out.shape))
+    hbm = sum(
+        _nelems(lshapes[i]) * np.dtype(lf.value.dtype).itemsize
+        for i, lf in enumerate(leaves)
+    )
+    hbm += sum(
+        _nelems(avals[s].shape) * np.dtype(avals[s].dtype).itemsize
+        for s in out_slots
+    )
+    return n_ops, flops, float(hbm)
+
+
 def _run_many(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
+    """Telemetry-spanned wrapper: every multi-root lowering runs inside a
+    ``fusion.materialize`` span (nested under any caller span; at trace
+    level it lands in Perfetto via ``jax.profiler.TraceAnnotation``)."""
+    with telemetry.span("fusion.materialize", roots=len(exprs)):
+        return _run_many_impl(exprs, gshapes, splits, comm, donate)
+
+
+def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
     """Lower several DAG roots as ONE multi-output program (or fetch the
     cached executable) and run it, returning one physical array per root.
 
@@ -852,6 +968,29 @@ def _run_many(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
         _STATS["misses"] += 1
         n_roots = len(out_slots)
         _ROOTS_PER_PROGRAM[n_roots] = _ROOTS_PER_PROGRAM.get(n_roots, 0) + 1
+        # ledger + flight-recorder bookkeeping happens only on the miss
+        # path — by definition not the steady state, so the DAG cost walk
+        # and fingerprint hash add nothing to cached traffic
+        fp = None
+        ops = 0
+        flops = hbm = 0.0
+        mesh_info = {"devices": comm.size}
+        if telemetry.ledger_enabled():
+            try:
+                fp = _program_fingerprint(instrs, out_slots)
+                ops, flops, hbm = _estimate_cost(
+                    instrs, leaves, lshapes, out_slots
+                )
+            except Exception:  # an estimator bug must never block lowering
+                pass
+        telemetry.record_event(
+            "cache_miss", fingerprint=fp, n_roots=n_roots,
+        )
+        telemetry.record_event(
+            "compile_begin", fingerprint=fp, n_roots=n_roots, ops=ops,
+            mesh=mesh_info, flops=flops, hbm_bytes=hbm,
+        )
+        t0 = time.monotonic()
         try:
             guard.fire("fusion.compile")
             program = None
@@ -889,20 +1028,39 @@ def _run_many(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
         except Exception:
             # trace/lowering/compile/first-run failure: the executable is
             # unusable — do NOT cache it; recompute per-op eagerly
+            telemetry.record_event(
+                "compile_end", fingerprint=fp, ok=False,
+                dur_s=round(time.monotonic() - t0, 6),
+            )
             count_fallback("compile_error")
             flag = None
             outs = _eager_fallback(
                 instrs, vals, lshapes, out_slots, gshapes, splits, comm, targets
             )
         else:
+            telemetry.record_event(
+                "compile_end", fingerprint=fp, ok=True,
+                dur_s=round(time.monotonic() - t0, 6),
+                n_roots=n_roots, ops=ops, flops=flops, hbm_bytes=hbm,
+                mesh=mesh_info,
+            )
+            if fp is not None:
+                telemetry.record_program(
+                    fp, kind="fused", n_roots=n_roots, ops=ops,
+                    flops=flops, hbm_bytes=hbm, mesh=mesh_info,
+                )
+            entry.fp = fp
             _CACHE[key] = entry
             while len(_CACHE) > _CACHE_MAX:
-                _CACHE.popitem(last=False)
+                _, evicted = _CACHE.popitem(last=False)
                 _STATS["evictions"] += 1
+                telemetry.record_event("cache_evict", fingerprint=evicted.fp)
     else:
         _STATS["hits"] += 1
         entry.hits += 1
         _CACHE.move_to_end(key)
+        telemetry.program_hit(entry.fp)
+        telemetry.record_event("cache_hit", fingerprint=entry.fp)
         try:
             guard.fire("fusion.exec")
             outs = entry.jitted(*vals)

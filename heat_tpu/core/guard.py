@@ -173,7 +173,14 @@ class NonFiniteWarning(RuntimeWarning):
     """Default-mode guard trip: a fused chain introduced NaN/Inf.  Carries
     the same attribution text as :class:`NonFiniteError` — op name, user
     source line, subtree — but follows NumPy's warning semantics
-    (``sqrt(-1)`` warns, it does not throw)."""
+    (``sqrt(-1)`` warns, it does not throw).
+
+    ``event_id`` carries the sequence number of the ``guard_blame`` event
+    the flight recorder logged for this trip (``None`` below
+    ``HEAT_TPU_TELEMETRY=events``), so a caught warning correlates
+    directly with its entry in ``ht.telemetry.events()``."""
+
+    event_id: Optional[int] = None
 
 
 class NonFiniteError(FloatingPointError):
@@ -189,6 +196,9 @@ class NonFiniteError(FloatingPointError):
             the offending op, or ``None`` when unattributable.
         subtree: ``fusion.describe()``-style rendering of the offending
             op's subtree (the linearized prefix ending at the op).
+        event_id: sequence number of the flight recorder's ``guard_blame``
+            event for this trip (``None`` below
+            ``HEAT_TPU_TELEMETRY=events``).
     """
 
     def __init__(self, message: str, *, op=None, site=None, subtree=None):
@@ -196,6 +206,7 @@ class NonFiniteError(FloatingPointError):
         self.op = op
         self.site = site
         self.subtree = subtree
+        self.event_id: Optional[int] = None
 
 
 # ------------------------------------------------------- injection hooks

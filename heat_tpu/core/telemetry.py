@@ -1,0 +1,642 @@
+"""Unified telemetry: metrics registry, flight recorder, spans, cost ledger.
+
+Five PRs of subsystems left observability scattered: three hand-rolled
+``_STATS`` dicts (``fusion.cache_stats()``, ``transport.stats()``,
+``overlap.stats()``), guard warnings with no machine-readable trail, a
+:class:`~heat_tpu.utils.fault.StallDetector` whose stalls vanish into a
+callback, and a bench-only ``@monitor`` decorator.  There was no single
+place to answer *"what did this run compile, retry, fall back on, and why
+was it slow?"* — the substrate the serving/scale-out roadmap item needs
+for admission/backpressure and warm-cache batching.  This module is that
+place, exposed as ``ht.telemetry``.  Four parts:
+
+**Metrics registry.**  Every counter group registers ONCE with its
+defaults (:func:`register_group`); the registry hands back the live dict
+the owning module mutates on its hot path (a plain dict increment — no
+wrapper, no lock, no new cost).  :func:`snapshot` returns every group as
+one nested dict, :func:`export_prometheus` emits the text exposition
+format for scrapers, and :func:`reset_all` / :func:`reset_group` restore
+the registered defaults *in place* — nested dicts keep their object
+identity, so module-level aliases stay valid, and a counter added to the
+defaults is reset automatically (the ``fused_tails`` counter previously
+had to be added to ``transport._STATS`` *and* ``reset_stats()`` by hand;
+registry-managed reset makes that drift impossible).
+
+**Flight recorder.**  A bounded ring buffer of structured events with
+monotonic timestamps and sequence numbers: fusion compile start/end
+(fingerprint, root arity, mesh), cache hit/eviction, fallback with
+reason, transport OOM retries with the halved tile budget, guard
+replay/blame, ring-vs-GSPMD dispatch decisions with their cost-model
+inputs, stall heartbeats.  Gated by ``HEAT_TPU_TELEMETRY``:
+
+    ``off``       record nothing (no events, no ledger, no spans)
+    ``counters``  cost ledger on; no events (the default)
+    ``events``    + flight recorder + span events
+    ``trace``     + ``jax.profiler.TraceAnnotation`` per span, so spans
+                  land in Perfetto traces captured via
+                  ``monitor.profile_trace``
+
+:func:`events` reads the buffer, :func:`dump` writes a postmortem
+document, and :func:`postmortem` is invoked automatically on a guard
+``raise``, an exec-error eager fallback, and a detected stall — set
+``HEAT_TPU_TELEMETRY_DUMP=/path`` to have those write the document to
+disk unprompted.
+
+**Span tracing.**  :func:`span` is a context manager *and* decorator
+with nesting (parent ids ride the events) wired into
+``materialize``/``materialize_all``, the transport kernels, ring
+dispatch, and estimator ``.fit`` loops.  In ``trace`` mode each span
+also enters ``jax.profiler.TraceAnnotation``, so the same names appear
+in Perfetto.  Open spans are visible across threads
+(:func:`open_spans`) — a stall postmortem shows what was in flight.
+
+**Cost ledger.**  At fusion compile time the op DAG is walked once to
+estimate FLOPs and HBM bytes (elementwise: one FLOP per output element;
+reductions/composites: one per input element; matmul: ``2·m·k·n`` — the
+same accounting the overlap dispatcher's bytes-per-step model uses for
+its operands).  The estimate attaches to the compile event and to a
+per-program ledger (:func:`programs`), so cb rows can derive
+achieved-vs-roofline from telemetry instead of hand-computed constants.
+
+Costs when idle: ``off``/``counters`` mode adds one integer compare per
+would-be event; the ledger walk runs only at compile-cache misses (by
+definition not the steady state).  The ``telemetry_overhead`` cb row
+measures the events-on tax against a <2% bar.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import io
+import itertools
+import json
+import os
+import re
+import sys
+import threading
+import time
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "current_span",
+    "dump",
+    "events",
+    "clear_events",
+    "export_prometheus",
+    "level",
+    "open_spans",
+    "postmortem",
+    "program_hit",
+    "programs",
+    "record_event",
+    "record_program",
+    "register_group",
+    "reset_all",
+    "reset_group",
+    "reset_programs",
+    "set_capacity",
+    "set_level",
+    "snapshot",
+    "snapshot_group",
+    "span",
+    "telemetry_level",
+]
+
+
+# ------------------------------------------------------------------- levels
+# Ordered modes; each includes everything below it.  Integers so the hot
+# gate (`if _LEVEL < _EVENTS: return`) is one compare.
+
+_LEVELS = ("off", "counters", "events", "trace")
+_OFF, _COUNTERS, _EVENTS, _TRACE = range(4)
+
+
+def _env_level() -> int:
+    raw = os.environ.get("HEAT_TPU_TELEMETRY", "counters").strip().lower()
+    if raw in ("off", "0", "false", "no", "none"):
+        return _OFF
+    if raw in ("", "counters", "on", "default"):
+        return _COUNTERS
+    if raw == "events":
+        return _EVENTS
+    if raw == "trace":
+        return _TRACE
+    return _COUNTERS
+
+
+_LEVEL = _env_level()
+
+
+def level() -> str:
+    """Current telemetry level: ``off`` | ``counters`` | ``events`` |
+    ``trace`` (``HEAT_TPU_TELEMETRY``)."""
+    return _LEVELS[_LEVEL]
+
+
+def set_level(lvl) -> str:
+    """Set the level by name (or int rank); returns the previous name."""
+    global _LEVEL
+    prev = _LEVELS[_LEVEL]
+    if isinstance(lvl, str):
+        if lvl not in _LEVELS:
+            raise ValueError(f"level must be one of {_LEVELS}, got {lvl!r}")
+        _LEVEL = _LEVELS.index(lvl)
+    else:
+        _LEVEL = min(max(int(lvl), _OFF), _TRACE)
+    return prev
+
+
+@contextmanager
+def telemetry_level(lvl):
+    """Scoped :func:`set_level` (``with telemetry.telemetry_level("events")``)."""
+    prev = set_level(lvl)
+    try:
+        yield
+    finally:
+        set_level(prev)
+
+
+def ledger_enabled() -> bool:
+    """Whether the cost ledger records (``counters`` level and above)."""
+    return _LEVEL >= _COUNTERS
+
+
+def events_enabled() -> bool:
+    """Whether the flight recorder records (``events`` level and above)."""
+    return _LEVEL >= _EVENTS
+
+
+def trace_enabled() -> bool:
+    """Whether spans enter ``jax.profiler.TraceAnnotation`` (``trace``)."""
+    return _LEVEL >= _TRACE
+
+
+# ----------------------------------------------------------- metrics registry
+
+class _Group:
+    __slots__ = ("name", "live", "defaults", "extra", "on_reset")
+
+    def __init__(self, name, live, defaults, extra, on_reset):
+        self.name = name
+        self.live = live
+        self.defaults = defaults
+        self.extra = extra
+        self.on_reset = on_reset
+
+
+_GROUPS: "OrderedDict[str, _Group]" = OrderedDict()
+
+
+def register_group(
+    name: str,
+    defaults: Dict[str, Any],
+    *,
+    extra: Optional[Callable[[], Dict[str, Any]]] = None,
+    on_reset: Optional[Callable[[], None]] = None,
+) -> Dict[str, Any]:
+    """Register a named counter group and return its LIVE dict.
+
+    The owning module mutates the returned dict directly (plain dict
+    increments — registration adds zero hot-path cost).  ``defaults`` is
+    deep-copied both at registration and on every reset, so the reset
+    contract lives in exactly one place: add a counter to the defaults
+    and :func:`reset_group` handles it forever.  ``extra`` contributes
+    derived read-only fields to snapshots (e.g. a cache's live ``size``);
+    ``on_reset`` runs extra reset work (e.g. clearing a side table).
+
+    Re-registering an existing name returns the already-live dict (the
+    registration is idempotent across module reloads)."""
+    got = _GROUPS.get(name)
+    if got is not None:
+        return got.live
+    live = copy.deepcopy(defaults)
+    _GROUPS[name] = _Group(name, live, copy.deepcopy(defaults), extra, on_reset)
+    return live
+
+
+def _reset_in_place(live: dict, defaults: dict) -> None:
+    """Restore ``defaults`` into ``live`` without replacing nested dict
+    objects, so module-level aliases into the group stay valid."""
+    for k in list(live.keys()):
+        if k not in defaults:
+            del live[k]
+    for k, dv in defaults.items():
+        cur = live.get(k)
+        if isinstance(dv, dict) and isinstance(cur, dict):
+            _reset_in_place(cur, dv)
+        else:
+            live[k] = copy.deepcopy(dv)
+
+
+def reset_group(name: str) -> None:
+    """Restore one group to its registered defaults (in place)."""
+    g = _GROUPS[name]
+    _reset_in_place(g.live, g.defaults)
+    if g.on_reset is not None:
+        g.on_reset()
+
+
+def reset_all() -> None:
+    """Restore EVERY registered group to its defaults — the single reset
+    that replaces the hand-maintained per-module ones."""
+    for name in _GROUPS:
+        reset_group(name)
+
+
+def snapshot_group(name: str) -> Dict[str, Any]:
+    """Deep-copied snapshot of one group, with its ``extra`` fields
+    merged in."""
+    g = _GROUPS[name]
+    out = copy.deepcopy(g.live)
+    if g.extra is not None:
+        out.update(g.extra())
+    return out
+
+
+def snapshot() -> Dict[str, Dict[str, Any]]:
+    """Every registered counter group as ONE nested dict:
+    ``{"fusion": {...}, "transport": {...}, "overlap": {...}, ...}``."""
+    return {name: snapshot_group(name) for name in _GROUPS}
+
+
+_METRIC_SAFE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_lines(prefix: str, value, lines: List[str]) -> None:
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, (int, float)):
+        lines.append(f"# TYPE {prefix} gauge")
+        lines.append(f"{prefix} {value}")
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _prom_lines(f"{prefix}_{_METRIC_SAFE.sub('_', str(k))}", v, lines)
+    # None / strings / other payloads have no numeric exposition — skipped
+
+
+def export_prometheus() -> str:
+    """Text exposition format (one ``# TYPE`` + value line per numeric
+    leaf): every registered group flattened as
+    ``heat_tpu_<group>_<counter>``, nested dicts joined with ``_``, plus
+    recorder/ledger gauges.  Non-numeric fields are skipped."""
+    lines: List[str] = []
+    for name in _GROUPS:
+        _prom_lines(
+            f"heat_tpu_{_METRIC_SAFE.sub('_', name)}", snapshot_group(name), lines
+        )
+    _prom_lines("heat_tpu_telemetry_events", len(_RING), lines)
+    _prom_lines("heat_tpu_telemetry_events_dropped", _DROPPED[0], lines)
+    _prom_lines("heat_tpu_telemetry_programs", len(_PROGRAMS), lines)
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------- flight recorder
+
+def _env_capacity() -> int:
+    raw = os.environ.get("HEAT_TPU_TELEMETRY_CAPACITY", "").strip()
+    try:
+        n = int(raw) if raw else 2048
+    except ValueError:
+        n = 2048
+    return max(n, 1)
+
+
+_RING: "deque[dict]" = deque(maxlen=_env_capacity())
+_SEQ = itertools.count()
+_DROPPED = [0]  # events evicted by the ring bound (list: mutable module slot)
+
+
+def set_capacity(n: int) -> int:
+    """Resize the ring buffer (keeps the newest events that fit).
+    Returns the previous capacity."""
+    global _RING
+    prev = _RING.maxlen
+    _RING = deque(_RING, maxlen=max(int(n), 1))
+    return prev
+
+
+# event keys the recorder itself owns; caller fields shadowing them are
+# re-keyed with an "x_" prefix instead of corrupting the envelope
+_RESERVED_FIELDS = frozenset(("seq", "ts", "kind", "span"))
+
+
+def record_event(kind: str, /, **fields) -> Optional[int]:
+    """Append one structured event to the flight recorder.
+
+    Returns the event's sequence number, or ``None`` below ``events``
+    level (the no-record gate is one integer compare — safe to call on
+    hot paths unconditionally).  Events carry a monotonic ``ts``, the
+    calling thread's innermost open span id (``span``), and the caller's
+    ``fields`` (a field named like an envelope key — ``kind``/``seq``/
+    ``ts``/``span`` — is stored re-keyed as ``x_<name>``)."""
+    if _LEVEL < _EVENTS:
+        return None
+    seq = next(_SEQ)
+    if len(_RING) == _RING.maxlen:
+        _DROPPED[0] += 1
+    cur = _span_stack()
+    evt = {
+        "seq": seq,
+        "ts": time.monotonic(),
+        "kind": kind,
+        "span": cur[-1].id if cur else None,
+    }
+    for k, v in fields.items():
+        evt[f"x_{k}" if k in _RESERVED_FIELDS else k] = v
+    _RING.append(evt)
+    return seq
+
+
+def events(kind: Optional[str] = None) -> List[dict]:
+    """The recorded events, oldest first; ``kind`` filters."""
+    got = list(_RING)
+    if kind is not None:
+        got = [e for e in got if e["kind"] == kind]
+    return got
+
+
+def clear_events() -> None:
+    """Drop the recorded events (tests/benchmarks)."""
+    _RING.clear()
+    _DROPPED[0] = 0
+
+
+def dump(file=None) -> None:
+    """Write a postmortem document — level, open spans, the full event
+    ring, the program ledger, and a counters snapshot — as one JSON
+    object.  ``file`` is a path or a writable handle (default stderr)."""
+    doc = {
+        "telemetry_level": level(),
+        "capacity": _RING.maxlen,
+        "dropped": _DROPPED[0],
+        "open_spans": open_spans(),
+        "events": events(),
+        "programs": programs(),
+        "counters": snapshot(),
+    }
+    if isinstance(file, (str, os.PathLike)):
+        with open(file, "w") as fh:
+            json.dump(doc, fh, indent=1, default=repr)
+        return
+    out = file or sys.stderr
+    json.dump(doc, out, indent=1, default=repr)
+    out.write("\n")
+
+
+def postmortem(reason: str, **fields) -> None:
+    """Automatic degradation dump: called on a guard ``raise``, an
+    exec-error eager fallback, and a detected stall.  Records a
+    ``postmortem`` event; when ``HEAT_TPU_TELEMETRY_DUMP`` names a path,
+    the full :func:`dump` document is written there (a repeated
+    postmortem in one process appends ``.2``, ``.3``, ... instead of
+    overwriting the first trail).  No-op below ``events`` level."""
+    if _LEVEL < _EVENTS:
+        return
+    record_event("postmortem", reason=reason, **fields)
+    path = os.environ.get("HEAT_TPU_TELEMETRY_DUMP", "").strip()
+    if not path:
+        return
+    try:
+        final = path
+        n = 1
+        while os.path.exists(final):
+            n += 1
+            final = f"{path}.{n}"
+        dump(final)
+    except OSError:  # a broken dump path must never mask the real failure
+        pass
+
+
+# ------------------------------------------------------------- span tracing
+
+class _SpanState:
+    __slots__ = ("id", "name", "parent", "t0")
+
+    def __init__(self, sid, name, parent, t0):
+        self.id = sid
+        self.name = name
+        self.parent = parent
+        self.t0 = t0
+
+
+_SPAN_IDS = itertools.count(1)
+_TLS = threading.local()
+# thread ident -> that thread's open-span stack; lets the stall watchdog
+# (a different thread) see what the workload had in flight
+_ALL_STACKS: Dict[int, List[_SpanState]] = {}
+
+
+def _span_stack() -> List[_SpanState]:
+    got = getattr(_TLS, "stack", None)
+    if got is None:
+        got = _TLS.stack = []
+    return got
+
+
+def current_span() -> Optional[dict]:
+    """``{"id", "name", "parent"}`` of the calling thread's innermost
+    open span, or ``None``."""
+    cur = _span_stack()
+    if not cur:
+        return None
+    s = cur[-1]
+    return {"id": s.id, "name": s.name, "parent": s.parent}
+
+
+def open_spans() -> List[dict]:
+    """Every open span across ALL threads, outermost first per thread —
+    what a stall postmortem shows as "in flight"."""
+    out = []
+    for tid, stack in list(_ALL_STACKS.items()):
+        for s in list(stack):
+            out.append(
+                {"thread": tid, "id": s.id, "name": s.name, "parent": s.parent}
+            )
+    return out
+
+
+class span:
+    """Context manager AND decorator marking one timed region.
+
+    ``with telemetry.span("transport.resplit", tile_bytes=tb): ...`` or::
+
+        @telemetry.span("kmeans.fit")
+        def fit(self, x): ...
+
+    At ``events`` level, entry/exit append ``span_begin``/``span_end``
+    events carrying the span id, its parent id (nesting), the ``attrs``,
+    and the wall duration; every event recorded inside the region carries
+    the span's id.  At ``trace`` level the region additionally enters
+    ``jax.profiler.TraceAnnotation(name)`` so it lands in Perfetto traces
+    (``monitor.profile_trace``).  Below ``events`` level enter/exit are a
+    single integer compare each — spans stay wired on hot paths at zero
+    steady-state cost."""
+
+    __slots__ = ("name", "attrs", "_state", "_annot")
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._state = None
+        self._annot = None
+
+    def __call__(self, fn: Callable) -> Callable:
+        import functools
+
+        name, attrs = self.name, self.attrs
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with span(name, **attrs):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    def __enter__(self) -> "span":
+        if _LEVEL < _EVENTS:
+            return self
+        stack = _span_stack()
+        parent = stack[-1].id if stack else None
+        st = _SpanState(next(_SPAN_IDS), self.name, parent, time.monotonic())
+        # record_event BEFORE pushing, so span_begin carries the PARENT id
+        # in its own `span` field (the begin belongs to the enclosing span)
+        seq = record_event(
+            "span_begin", id=st.id, name=self.name, parent=parent,
+            **self.attrs,
+        )
+        del seq
+        stack.append(st)
+        _ALL_STACKS[threading.get_ident()] = stack
+        self._state = st
+        if _LEVEL >= _TRACE:
+            try:
+                import jax
+
+                self._annot = jax.profiler.TraceAnnotation(self.name)
+                self._annot.__enter__()
+            except Exception:
+                self._annot = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        st = self._state
+        if st is None:
+            return False
+        self._state = None
+        if self._annot is not None:
+            try:
+                self._annot.__exit__(exc_type, exc, tb)
+            finally:
+                self._annot = None
+        stack = _span_stack()
+        while stack and stack[-1].id != st.id:  # tolerate unbalanced exits
+            stack.pop()
+        if stack:
+            stack.pop()
+        if not stack:
+            _ALL_STACKS.pop(threading.get_ident(), None)
+        record_event(
+            "span_end", id=st.id, name=st.name, parent=st.parent,
+            dur_s=round(time.monotonic() - st.t0, 6),
+            **({"status": "error", "error": exc_type.__name__}
+               if exc_type is not None else {}),
+        )
+        return False
+
+
+# --------------------------------------------------------------- cost ledger
+
+_PROGRAMS: "OrderedDict[str, dict]" = OrderedDict()
+_PROGRAMS_MAX = 1024
+
+
+def fingerprint(parts) -> str:
+    """Short stable digest of a canonical program description (the fusion
+    engine passes its display-name instruction rendering)."""
+    h = hashlib.sha1("|".join(str(p) for p in parts).encode())
+    return h.hexdigest()[:12]
+
+
+def record_program(
+    fp: str,
+    *,
+    kind: str = "fused",
+    n_roots: int = 1,
+    ops: int = 0,
+    flops: float = 0.0,
+    hbm_bytes: float = 0.0,
+    mesh: Optional[dict] = None,
+    **extra,
+) -> None:
+    """Ledger one compiled program: its cost-model estimate (FLOPs + HBM
+    bytes of mandatory traffic) attaches to the fingerprint so cb rows
+    and dashboards derive achieved-vs-roofline from telemetry.  Called at
+    fusion compile-cache misses and ring-matmul builds; re-recording an
+    existing fingerprint refreshes the estimate without touching its hit
+    count.  No-op at ``off`` level."""
+    if _LEVEL < _COUNTERS:
+        return
+    got = _PROGRAMS.get(fp)
+    hits = got["hits"] if got else 0
+    compiles = (got["compiles"] if got else 0) + 1
+    _PROGRAMS[fp] = {
+        "fingerprint": fp,
+        "kind": kind,
+        "n_roots": int(n_roots),
+        "ops": int(ops),
+        "flops": float(flops),
+        "hbm_bytes": float(hbm_bytes),
+        "mesh": mesh,
+        "compiles": compiles,
+        "hits": hits,
+        **extra,
+    }
+    _PROGRAMS.move_to_end(fp)
+    while len(_PROGRAMS) > _PROGRAMS_MAX:
+        _PROGRAMS.popitem(last=False)
+
+
+def program_hit(fp: Optional[str]) -> None:
+    """Count one cache-served execution of a ledgered program."""
+    if fp is None or _LEVEL < _COUNTERS:
+        return
+    got = _PROGRAMS.get(fp)
+    if got is not None:
+        got["hits"] += 1
+
+
+def programs() -> List[dict]:
+    """The per-program cost ledger, oldest entry first: one dict per
+    compiled program with ``fingerprint``, ``kind``, ``n_roots``,
+    ``ops``, ``flops``, ``hbm_bytes``, ``mesh``, ``compiles`` and
+    ``hits``."""
+    return [dict(v) for v in _PROGRAMS.values()]
+
+
+def reset_programs() -> None:
+    """Drop the cost ledger (tests/benchmarks)."""
+    _PROGRAMS.clear()
+
+
+def reset() -> None:
+    """Full telemetry reset: counters, events, and the ledger."""
+    reset_all()
+    clear_events()
+    reset_programs()
+
+
+# ------------------------------------------------------------- convenience
+
+def describe() -> str:
+    """One human-readable status block (debugging aid)."""
+    buf = io.StringIO()
+    buf.write(f"telemetry level={level()} capacity={_RING.maxlen} "
+              f"events={len(_RING)} dropped={_DROPPED[0]} "
+              f"programs={len(_PROGRAMS)}\n")
+    for name in _GROUPS:
+        buf.write(f"  [{name}] {snapshot_group(name)}\n")
+    return buf.getvalue()

@@ -23,6 +23,9 @@ from .mesh import (
 )
 from . import collectives
 from . import overlap
+# imported at package load so the "transport" telemetry group is
+# registered (and visible in ht.telemetry.snapshot()) before any traffic
+from . import transport
 from . import pipeline
 from .pipeline import pipeline_apply, stack_stage_params
 from . import expert
@@ -40,6 +43,7 @@ __all__ = [
     "hybrid_mesh",
     "collectives",
     "overlap",
+    "transport",
     "pipeline",
     "pipeline_apply",
     "stack_stage_params",

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..core import telemetry
 from .collectives import (
     all_gather,
     jit_shard_map_cached,
@@ -162,16 +163,23 @@ def _decide(case, out_split, m, k, n, S, comp_isz, acc_isz):
 
 # --------------------------------------------------------------------- stats
 
-_STATS = {
-    "calls": 0,
-    "ring_calls": 0,
-    "gspmd_calls": 0,
-    "ring_builds": 0,
-    "cache_hits": 0,
-    "by_schedule": {"ring_ag": 0, "ring_rs": 0, "ring_col": 0, "gspmd": 0},
-    "last": None,
-}
 _SEEN: set = set()
+
+# Registered as the "overlap" telemetry group; on_reset clears the
+# build-dedup set alongside the counters (registry-managed, one site).
+_STATS = telemetry.register_group(
+    "overlap",
+    {
+        "calls": 0,
+        "ring_calls": 0,
+        "gspmd_calls": 0,
+        "ring_builds": 0,
+        "cache_hits": 0,
+        "by_schedule": {"ring_ag": 0, "ring_rs": 0, "ring_col": 0, "gspmd": 0},
+        "last": None,
+    },
+    on_reset=_SEEN.clear,
+)
 
 
 def stats() -> dict:
@@ -180,21 +188,17 @@ def stats() -> dict:
     (eager ring calls served by an already-built program; lazy-chain reuse
     is counted by ``fusion.cache_stats()`` instead), ``by_schedule``, and
     ``last`` — the most recent decision's schedule, steps, bytes/step,
-    out-split and reason."""
-    out = dict(_STATS)
-    out["by_schedule"] = dict(_STATS["by_schedule"])
-    out["last"] = dict(_STATS["last"]) if _STATS["last"] else None
-    return out
+    out-split and reason.
+
+    Thin shim over ``telemetry.snapshot_group("overlap")`` — the same
+    counters appear in ``ht.telemetry.snapshot()``."""
+    return telemetry.snapshot_group("overlap")
 
 
 def reset_stats() -> None:
-    _STATS.update(
-        calls=0, ring_calls=0, gspmd_calls=0, ring_builds=0, cache_hits=0,
-        last=None,
-    )
-    for key in _STATS["by_schedule"]:
-        _STATS["by_schedule"][key] = 0
-    _SEEN.clear()
+    """Zero the dispatcher counters and the build-dedup set
+    (registry-managed via ``telemetry.reset_group``)."""
+    telemetry.reset_group("overlap")
 
 
 def _record(schedule, *, steps=0, bps=0, out_split=None, reason="",
@@ -213,6 +217,13 @@ def _record(schedule, *, steps=0, bps=0, out_split=None, reason="",
         "schedule": schedule, "steps": steps, "bytes_per_step": bps,
         "out_split": out_split, "reason": reason,
     }
+    # the flight recorder keeps the decision WITH its cost-model inputs —
+    # the ring-vs-GSPMD trail the counters alone cannot reconstruct
+    telemetry.record_event(
+        "matmul_dispatch", schedule=schedule, steps=steps,
+        bytes_per_step=bps, out_split=out_split, reason=reason,
+        cache_hit=cache_hit,
+    )
 
 
 # ---------------------------------------------------------------- ring sweep
@@ -531,12 +542,31 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     seen_key = (id(comm.mesh), spec)
     hit = seen_key in _SEEN
     _SEEN.add(seen_key)
-    fn = jit_shard_map_cached(_build_ring, comm.mesh, spec)
-    out = fn(a, b, *extras)
+    with telemetry.span("overlap.ring_" + case, m=m, k=k, n=n):
+        fn = jit_shard_map_cached(_build_ring, comm.mesh, spec)
+        out = fn(a, b, *extras)
     _record(
         "ring_" + case, steps=comm.size, bps=bps, out_split=out_split,
         reason=reason, cache_hit=hit,
     )
+    # ledger the ring program with the overlap cost model's own numbers:
+    # GEMM FLOPs plus the mandatory HBM traffic (operands + result once —
+    # the per-step wire bytes are ICI, not HBM)
+    if not hit:
+        telemetry.record_program(
+            telemetry.fingerprint(
+                ("ring", case, out_split, m, k, n, str(comp), len(steps)),
+            ),
+            kind="ring_matmul",
+            ops=1 + len(steps),
+            flops=2.0 * m * k * n,
+            hbm_bytes=float(
+                (m * k + k * n) * comp.itemsize + m * n * acc_isz
+            ),
+            mesh={"devices": comm.size},
+            schedule="ring_" + case,
+            bytes_per_step=bps,
+        )
     return out
 
 

@@ -69,7 +69,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core import guard
+from ..core import guard, telemetry
 from .collectives import shard_map_unchecked
 
 __all__ = [
@@ -118,19 +118,25 @@ TILE_FLOOR_BYTES = 64 << 10
 
 # ------------------------------------------------------------- OOM backoff
 
-_STATS = {
-    # successful-but-retried transfers: each halving of the budget counts 1
-    "oom_retries": 0,
-    # transfers that still hit RESOURCE_EXHAUSTED at the floor (re-raised)
-    "oom_exhausted": 0,
-    # budget the most recent tiled transfer ran (and succeeded) at
-    "last_tile_bytes": None,
-    # per-kernel retry counts: {"resplit": n, "take": n, "reshape": n}
-    "retries_by_kind": {},
-    # split-terminated lazy chains whose elementwise tail lowered INTO the
-    # per-tile resplit loop (no separate pre-pass materialization)
-    "fused_tails": 0,
-}
+# Registered as the "transport" telemetry group: the registry owns the
+# reset contract (the `fused_tails` counter previously had to be added
+# here AND in reset_stats() by hand — that drift class is gone).
+_STATS = telemetry.register_group(
+    "transport",
+    {
+        # successful-but-retried transfers: each budget halving counts 1
+        "oom_retries": 0,
+        # transfers that still hit RESOURCE_EXHAUSTED at the floor (re-raised)
+        "oom_exhausted": 0,
+        # budget the most recent tiled transfer ran (and succeeded) at
+        "last_tile_bytes": None,
+        # per-kernel retry counts: {"resplit": n, "take": n, "reshape": n}
+        "retries_by_kind": {},
+        # split-terminated lazy chains whose elementwise tail lowered INTO
+        # the per-tile resplit loop (no separate pre-pass materialization)
+        "fused_tails": 0,
+    },
+)
 
 
 def stats() -> dict:
@@ -140,19 +146,17 @@ def stats() -> dict:
     budget the most recent transfer succeeded at — equal to the configured
     ``TILE_BYTES`` unless backoff engaged), ``retries_by_kind``, and
     ``fused_tails`` (lazy-chain tails fused into the resplit tile loop —
-    each one is a materialization pre-pass that did NOT happen)."""
-    out = dict(_STATS)
-    out["retries_by_kind"] = dict(_STATS["retries_by_kind"])
-    return out
+    each one is a materialization pre-pass that did NOT happen).
+
+    Thin shim over ``telemetry.snapshot_group("transport")`` — the same
+    counters appear in ``ht.telemetry.snapshot()``."""
+    return telemetry.snapshot_group("transport")
 
 
 def reset_stats() -> None:
-    """Zero the backoff counters (tests/benchmarks)."""
-    _STATS["oom_retries"] = 0
-    _STATS["oom_exhausted"] = 0
-    _STATS["last_tile_bytes"] = None
-    _STATS["retries_by_kind"] = {}
-    _STATS["fused_tails"] = 0
+    """Zero the backoff counters (registry-managed: every counter in the
+    registered defaults resets, with no second hand-maintained list)."""
+    telemetry.reset_group("transport")
 
 
 def _is_oom(err: Exception) -> bool:
@@ -183,23 +187,33 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int]):
     survives — but a mid-execution OOM on a donated transfer is not
     recoverable and will re-raise from the retry."""
     tb = TILE_BYTES if tile_bytes is None else int(tile_bytes)
-    while True:
-        try:
-            guard.fire(f"transport.{kind}")
-            out = run(tb)
-        except Exception as err:  # noqa: BLE001 — filtered to OOM below
-            if not _is_oom(err):
-                raise
-            if tb <= TILE_FLOOR_BYTES:
-                _STATS["oom_exhausted"] += 1
-                raise
-            tb = max(TILE_FLOOR_BYTES, tb >> 1)
-            _STATS["oom_retries"] += 1
-            by_kind = _STATS["retries_by_kind"]
-            by_kind[kind] = by_kind.get(kind, 0) + 1
-            continue
-        _STATS["last_tile_bytes"] = tb
-        return guard.corrupt(f"transport.{kind}", out)
+    with telemetry.span(f"transport.{kind}", tile_bytes=tb):
+        while True:
+            try:
+                guard.fire(f"transport.{kind}")
+                out = run(tb)
+            except Exception as err:  # noqa: BLE001 — filtered to OOM below
+                if not _is_oom(err):
+                    raise
+                if tb <= TILE_FLOOR_BYTES:
+                    _STATS["oom_exhausted"] += 1
+                    telemetry.record_event(
+                        "oom_exhausted", kernel=kind, tile_bytes=tb,
+                    )
+                    telemetry.postmortem("transport_oom_exhausted")
+                    raise
+                tb = max(TILE_FLOOR_BYTES, tb >> 1)
+                _STATS["oom_retries"] += 1
+                by_kind = _STATS["retries_by_kind"]
+                by_kind[kind] = by_kind.get(kind, 0) + 1
+                # the degradation trail: one event per halving, carrying
+                # the NEW (halved) budget the retry will run at
+                telemetry.record_event(
+                    "oom_retry", kernel=kind, tile_bytes=tb,
+                )
+                continue
+            _STATS["last_tile_bytes"] = tb
+            return guard.corrupt(f"transport.{kind}", out)
 
 # Beyond this many distinct ring shifts the rechunk degenerates toward a
 # latency-bound permute chain; callers fall back to the GSPMD route.
@@ -684,6 +698,9 @@ def _lower_split_tail(
 
     out = _with_oom_backoff("resplit", run, tile_bytes)
     _STATS["fused_tails"] += 1
+    telemetry.record_event(
+        "fused_tail", old_split=int(sa), new_split=int(sb), ops=len(instrs),
+    )
     return out
 
 

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray, _ensure_split
-from ..core import types
+from ..core import telemetry, types
 
 __all__ = ["Lasso"]
 
@@ -111,6 +111,7 @@ class Lasso(RegressionMixin, BaseEstimator):
         """Root mean squared error (reference: lasso.py:109)."""
         return float(jnp.sqrt(jnp.mean((gt.larray - yest.larray) ** 2)))
 
+    @telemetry.span("lasso.fit")
     def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
         """Coordinate descent until the coefficient change < tol (reference:
         lasso.py:121)."""

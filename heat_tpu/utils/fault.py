@@ -51,7 +51,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-from ..core import guard
+from ..core import guard, telemetry
 
 __all__ = [
     "ElasticFailure",
@@ -246,6 +246,9 @@ class StallDetector:
     def beat(self) -> None:
         self._last = time.monotonic()
         self._fired = False
+        # a stall postmortem reads the last heartbeats (and the spans
+        # open around them) straight out of the flight recorder
+        telemetry.record_event("heartbeat")
 
     def stop(self) -> None:
         self._stop.set()
@@ -258,6 +261,7 @@ class StallDetector:
         yourself for the standalone form."""
         with self._pause_lock:
             self._paused += 1
+        telemetry.record_event("stall_pause", depth=self._paused)
         return _StallPause(self)
 
     def resume(self) -> None:
@@ -270,6 +274,7 @@ class StallDetector:
             self._last = time.monotonic()
             self._fired = False
             self._paused = max(0, self._paused - 1)
+        telemetry.record_event("stall_resume", depth=self._paused)
 
     def _watch(self) -> None:
         poll = min(0.05, self.timeout / 4)
@@ -279,6 +284,16 @@ class StallDetector:
             quiet = time.monotonic() - self._last
             if quiet > self.timeout and not self._fired:
                 self._fired = True  # once per stall, not once per poll
+                # recorded from the watchdog thread: open_spans() reaches
+                # across threads, so the event names what the workload had
+                # in flight when it went quiet
+                telemetry.record_event(
+                    "stall",
+                    quiet_s=round(quiet, 3),
+                    timeout_s=self.timeout,
+                    open_spans=telemetry.open_spans(),
+                )
+                telemetry.postmortem("stall")
                 self.on_stall(quiet)
 
 

@@ -19,21 +19,31 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
+from ..core import telemetry
+
 __all__ = ["monitor", "measurements", "record", "report", "reset", "profile_trace"]
 
 _MEASUREMENTS: List[Dict[str, Any]] = []
 
 
 def _device_memory() -> Optional[int]:
-    """Bytes in use on device 0, where the backend exposes it (TPU does;
-    CPU returns None)."""
+    """Max bytes in use across the LOCAL devices, where the backend
+    exposes it (TPU does; CPU returns None).  The max — not device 0 —
+    is the number that matters on a multi-device mesh: uneven splits and
+    replicated operands peak on whichever device holds the remainder, and
+    reading only device 0 under-reports exactly when it hurts."""
+    worst = None
     try:
-        stats = jax.local_devices()[0].memory_stats()
+        for dev in jax.local_devices():
+            stats = dev.memory_stats()
+            if not stats:
+                continue
+            used = stats.get("bytes_in_use")
+            if used is not None and (worst is None or used > worst):
+                worst = used
     except Exception:
         return None
-    if not stats:
-        return None
-    return stats.get("bytes_in_use")
+    return worst
 
 
 def monitor(name: Optional[str] = None, emit: bool = True) -> Callable:
@@ -50,7 +60,25 @@ def monitor(name: Optional[str] = None, emit: bool = True) -> Callable:
         def wrapper(*args, **kwargs):
             mem0 = _device_memory()
             t0 = time.perf_counter()
-            out = fn(*args, **kwargs)
+            try:
+                out = fn(*args, **kwargs)
+            except Exception as err:
+                # the failed call IS a measurement: record how long it ran
+                # and that it died, then re-raise — a crash mid-suite must
+                # not erase the row (it used to vanish entirely)
+                wall = time.perf_counter() - t0
+                entry = {
+                    "name": label, "wall_s": round(wall, 6),
+                    "status": "error", "error": type(err).__name__,
+                }
+                _MEASUREMENTS.append(entry)
+                telemetry.record_event(
+                    "measurement", name=label, wall_s=entry["wall_s"],
+                    status="error", error=type(err).__name__,
+                )
+                if emit:
+                    print(json.dumps(entry), file=sys.stderr)
+                raise
             # drain async dispatch so the clock covers the device work.
             # NOTE: through a remote TPU tunnel this does not fully
             # synchronize (see bench.py) — workloads that need exact
@@ -68,6 +96,9 @@ def monitor(name: Optional[str] = None, emit: bool = True) -> Callable:
                 if mem0 is not None:
                     entry["device_bytes_delta"] = mem1 - mem0
             _MEASUREMENTS.append(entry)
+            telemetry.record_event(
+                "measurement", name=label, wall_s=entry["wall_s"],
+            )
             if emit:
                 print(json.dumps(entry), file=sys.stderr)
             return out

@@ -32,6 +32,12 @@
 #                 CSE (second call a pure hit), and a resplit-terminated
 #                 chain must lower into the transport tile loop with no
 #                 pre-pass materialization
+#  12. telemetry — unified-telemetry guards (ISSUE 8): the telemetry
+#                 test file, then fusion.py --verify-telemetry on the
+#                 forced 8-device mesh (off records nothing, registry
+#                 laws, injected-fault event trail, well-formed
+#                 Prometheus export), then a cb smoke run with --prom
+#                 proving a full run exports a valid snapshot
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -44,7 +50,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/11 suite (8-device mesh)"
+say "1/12 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -53,21 +59,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/11 core subset (4-device mesh)"
+say "2/12 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/11 parity audit (exits nonzero on any gap)"
+say "3/12 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/11 multi-chip dry-run"
+say "4/12 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/11 cb smoke"
+say "5/12 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -76,10 +82,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/11 copycheck"
+say "6/12 copycheck"
 python scripts/copycheck.py
 
-say "7/11 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/12 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -95,10 +101,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/11 fusion retrace guard (second call must hit the compile cache)"
+say "8/12 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/11 guardrails (fault injection + strict-guard retrace check)"
+say "9/12 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -109,7 +115,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/11 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/12 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -117,10 +123,37 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/11 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/12 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
+
+say "12/12 telemetry (flight recorder + registry laws + Prometheus export)"
+# the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
+# 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
+# trail, well-formed export), and a real cb run exporting a snapshot
+python -m pytest -q -p no:cacheprovider \
+  tests/test_telemetry.py 2>&1 | tee /tmp/ci_telemetry.log
+( cd benchmarks/cb && \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python fusion.py --verify-telemetry )
+( cd benchmarks/cb && HEAT_TPU_TELEMETRY=events \
+  python main.py --only manipulations --out /tmp/ci_cb_tel.json \
+  --prom /tmp/ci_cb_tel.prom )
+python - <<'EOF'
+lines = open("/tmp/ci_cb_tel.prom").read().splitlines()
+typed = {l.split()[2] for l in lines if l.startswith("# TYPE ")}
+samples = [l for l in lines if l and not l.startswith("#")]
+assert samples, "empty Prometheus export"
+for l in samples:
+    name, value = l.split()
+    assert name in typed, f"untyped sample {name}"
+    float(value)
+for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
+             "heat_tpu_overlap_calls", "heat_tpu_telemetry_events"):
+    assert want in typed, f"missing metric family {want}"
+print(f"cb --prom export OK: {len(samples)} gauges")
+EOF
 
 say "CI GREEN"

@@ -1,0 +1,444 @@
+"""Unified telemetry (ISSUE 8): registry laws, flight recorder, spans,
+cost ledger, and FaultInjector-driven degradation trails.
+
+Doctrine stays "no mocks": the trail tests inject faults through the real
+:class:`~heat_tpu.utils.fault.FaultInjector` / ``guard`` hooks and read
+the degradation back out of ``ht.telemetry.events()`` — the flight
+recorder must witness the production OOM-backoff and eager-fallback paths
+exactly as they ran.
+"""
+
+import threading
+import unittest
+import warnings
+
+import numpy as np
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core import fusion, guard, telemetry
+from heat_tpu.parallel import overlap, transport
+from heat_tpu.utils import fault
+
+from .base import TestCase
+
+
+def _mesh(n):
+    from heat_tpu.parallel.mesh import local_mesh
+
+    return local_mesh(n)
+
+
+def _reset_counters():
+    fusion.reset_cache()
+    transport.reset_stats()
+    overlap.reset_stats()
+
+
+class _EventsLevel:
+    """Scoped events level + clean recorder/ledger on both sides."""
+
+    def __init__(self, level="events"):
+        self.level = level
+
+    def __enter__(self):
+        self.prev = telemetry.set_level(self.level)
+        telemetry.clear_events()
+        return self
+
+    def __exit__(self, *exc):
+        telemetry.set_level(self.prev)
+        telemetry.clear_events()
+        return False
+
+
+class TestRegistryLaws(TestCase):
+    """snapshot()/reset_all() vs the per-module shim accessors."""
+
+    def setUp(self):
+        _reset_counters()
+
+    def tearDown(self):
+        _reset_counters()
+
+    def test_snapshot_covers_all_three_groups(self):
+        snap = telemetry.snapshot()
+        for group in ("fusion", "transport", "overlap"):
+            self.assertIn(group, snap)
+
+    def _law(self, comm):
+        """At any mesh size: run real traffic, then (a) each module shim
+        returns exactly the registry snapshot, (b) reset_all() restores
+        the registered defaults, (c) module-level aliases survive reset."""
+        _reset_counters()
+        rng = np.random.default_rng(comm.size)
+        a = ht.array(
+            rng.random((12, 8)).astype(np.float32), split=0, comm=comm
+        )
+        chained = (a + 1.0) * 2.0 - 0.5
+        _ = chained.larray
+        if comm.size > 1:
+            _ = ((a * 3.0).resplit(1)).larray
+        overlap.set_mode("gspmd")
+        try:
+            with fusion.fuse(False):
+                _ = ht.matmul(a, a.T.resplit(None) if comm.size > 1 else a.T)
+        finally:
+            overlap.set_mode(None)
+
+        snap = telemetry.snapshot()
+        self.assertEqual(snap["fusion"], fusion.cache_stats())
+        self.assertEqual(snap["transport"], transport.stats())
+        self.assertEqual(snap["overlap"], overlap.stats())
+        self.assertGreaterEqual(snap["fusion"]["misses"], 1)
+        self.assertGreaterEqual(snap["overlap"]["calls"], 1)
+
+        telemetry.reset_all()
+        after = telemetry.snapshot()
+        self.assertEqual(after["fusion"]["misses"], 0)
+        self.assertEqual(after["fusion"]["roots_per_program"], {})
+        self.assertEqual(after["transport"]["oom_retries"], 0)
+        self.assertEqual(after["transport"]["retries_by_kind"], {})
+        self.assertEqual(after["overlap"]["calls"], 0)
+        self.assertIsNone(after["overlap"]["last"])
+        # the in-place reset keeps module aliases live (the drift class the
+        # registry exists to kill: one defaults dict, no hand-kept resets)
+        self.assertIs(fusion._FALLBACK_REASONS, fusion._STATS["fallback_reasons"])
+        self.assertIs(fusion._ROOTS_PER_PROGRAM, fusion._STATS["roots_per_program"])
+
+    def test_laws_mesh1(self):
+        self._law(_mesh(1))
+
+    @unittest.skipUnless(len(jax.devices()) >= 4, "needs >= 4 devices")
+    def test_laws_mesh4(self):
+        self._law(_mesh(4))
+
+    @unittest.skipUnless(len(jax.devices()) >= 8, "needs >= 8 devices")
+    def test_laws_mesh8(self):
+        self._law(self.comm)
+
+    def test_prometheus_export_well_formed(self):
+        _ = ((ht.arange(16, dtype=ht.float32, split=0) + 1.0) * 2.0).larray
+        text = telemetry.export_prometheus()
+        lines = [ln for ln in text.splitlines() if ln]
+        self.assertTrue(lines)
+        names = set()
+        for ln in lines:
+            if ln.startswith("# TYPE "):
+                _, _, metric, mtype = ln.split(" ")
+                self.assertEqual(mtype, "gauge")
+                names.add(metric)
+            else:
+                metric, value = ln.rsplit(" ", 1)
+                self.assertIn(metric, names)  # every sample was typed
+                float(value)  # every sample is numeric
+        for expected in (
+            "heat_tpu_fusion_misses",
+            "heat_tpu_transport_oom_retries",
+            "heat_tpu_overlap_by_schedule_gspmd",
+            "heat_tpu_telemetry_events",
+        ):
+            self.assertIn(expected, names)
+
+
+class TestFlightRecorder(TestCase):
+    def test_ring_capacity_and_ordering(self):
+        with _EventsLevel():
+            prev_cap = telemetry.set_capacity(8)
+            try:
+                for i in range(20):
+                    telemetry.record_event("probe", i=i)
+                got = telemetry.events("probe")
+                self.assertEqual(len(got), 8)
+                # newest 8 survive, oldest first, seq strictly ascending
+                self.assertEqual([e["i"] for e in got], list(range(12, 20)))
+                seqs = [e["seq"] for e in got]
+                self.assertEqual(seqs, sorted(seqs))
+                ts = [e["ts"] for e in got]
+                self.assertEqual(ts, sorted(ts))
+            finally:
+                telemetry.set_capacity(prev_cap)
+
+    def test_off_records_nothing(self):
+        prev = telemetry.set_level("off")
+        telemetry.clear_events()
+        telemetry.reset_programs()
+        try:
+            x = ht.arange(24, dtype=ht.float32, split=0)
+            _ = ((x + 1.0) * 2.0).larray
+            self.assertEqual(telemetry.events(), [])
+            self.assertEqual(telemetry.programs(), [])
+            self.assertIsNone(telemetry.record_event("probe"))
+            with telemetry.span("dead"):
+                self.assertIsNone(telemetry.current_span())
+            self.assertEqual(telemetry.events(), [])
+        finally:
+            telemetry.set_level(prev)
+
+    def test_counters_level_has_ledger_but_no_events(self):
+        prev = telemetry.set_level("counters")
+        telemetry.clear_events()
+        telemetry.reset_programs()
+        fusion.reset_cache()
+        try:
+            x = ht.arange(24, dtype=ht.float32, split=0)
+            _ = ((x + 1.0) * 2.0).larray
+            self.assertEqual(telemetry.events(), [])
+            self.assertTrue(telemetry.programs())
+        finally:
+            telemetry.set_level(prev)
+
+    def test_dump_document(self):
+        import io
+        import json
+
+        with _EventsLevel():
+            telemetry.record_event("probe", i=1)
+            buf = io.StringIO()
+            telemetry.dump(buf)
+            doc = json.loads(buf.getvalue())
+            self.assertEqual(doc["telemetry_level"], "events")
+            self.assertIn("fusion", doc["counters"])
+            self.assertTrue(any(e["kind"] == "probe" for e in doc["events"]))
+
+
+class TestSpans(TestCase):
+    def setUp(self):
+        fusion.reset_cache()
+
+    @unittest.skipUnless(fusion.enabled(), "fusion engine disabled")
+    def test_nesting_under_materialize_all(self):
+        with _EventsLevel():
+            x = ht.arange(32, dtype=ht.float32, split=0)
+            with telemetry.span("user.outer", tag="t"):
+                a = (x + 1.0) * 2.0
+                b = (x - 3.0) / 4.0
+                ht.materialize_all(a, b)
+            begins = {e["name"]: e for e in telemetry.events("span_begin")}
+            self.assertIn("user.outer", begins)
+            self.assertIn("fusion.materialize", begins)
+            self.assertIsNone(begins["user.outer"]["parent"])
+            self.assertEqual(
+                begins["fusion.materialize"]["parent"],
+                begins["user.outer"]["id"],
+            )
+            ends = {e["name"]: e for e in telemetry.events("span_end")}
+            self.assertIn("fusion.materialize", ends)
+            self.assertGreaterEqual(ends["fusion.materialize"]["dur_s"], 0.0)
+            # events inside the region carry the innermost open span id
+            miss = telemetry.events("cache_miss")
+            self.assertTrue(miss)
+            self.assertEqual(
+                miss[0]["span"], begins["fusion.materialize"]["id"]
+            )
+
+    def test_decorator_form(self):
+        @telemetry.span("probe.fn", kind="test")
+        def work(n):
+            return n + 1
+
+        with _EventsLevel():
+            self.assertEqual(work(1), 2)
+            self.assertEqual(work(2), 3)
+            begins = telemetry.events("span_begin")
+            self.assertEqual(len(begins), 2)  # fresh span per call
+            self.assertNotEqual(begins[0]["id"], begins[1]["id"])
+
+    def test_open_spans_visible_across_threads(self):
+        with _EventsLevel():
+            entered = threading.Event()
+            release = threading.Event()
+            seen = {}
+
+            def worker():
+                with telemetry.span("worker.busy"):
+                    entered.set()
+                    release.wait(timeout=5)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            try:
+                self.assertTrue(entered.wait(timeout=5))
+                seen["open"] = [s["name"] for s in telemetry.open_spans()]
+            finally:
+                release.set()
+                t.join(timeout=5)
+            self.assertIn("worker.busy", seen["open"])
+            self.assertEqual(
+                [s["name"] for s in telemetry.open_spans()], []
+            )
+
+    def test_span_error_exit_recorded(self):
+        with _EventsLevel():
+            with self.assertRaises(ValueError):
+                with telemetry.span("probe.err"):
+                    raise ValueError("boom")
+            end = telemetry.events("span_end")[-1]
+            self.assertEqual(end["status"], "error")
+            self.assertEqual(end["error"], "ValueError")
+
+
+@unittest.skipUnless(fusion.enabled(), "fusion engine disabled")
+class TestFaultTrails(TestCase):
+    """The full degradation trail of injected faults must be readable out
+    of telemetry.events() — budgets, reasons, correlation ids."""
+
+    def setUp(self):
+        _reset_counters()
+
+    def tearDown(self):
+        _reset_counters()
+
+    def test_injected_oom_leaves_halving_trail(self):
+        with _EventsLevel():
+            inj = fault.FaultInjector(seed=0).oom_in("transport.resplit", times=2)
+            x = ht.array(
+                np.arange(64.0, dtype=np.float32).reshape(8, 8),
+                split=0, comm=self.comm,
+            )
+            with fault.injected(inj):
+                out = x.resplit(1)
+                _ = out.larray
+            trail = telemetry.events("oom_retry")
+            self.assertEqual(len(trail), 2)
+            self.assertTrue(all(e["kernel"] == "resplit" for e in trail))
+            # each event carries the NEW budget: strictly halving
+            self.assertEqual(
+                trail[1]["tile_bytes"], trail[0]["tile_bytes"] // 2
+            )
+            self.assertEqual(
+                transport.stats()["retries_by_kind"].get("resplit"), 2
+            )
+            # the retried transfer ran inside its transport span
+            spans = {e["id"]: e for e in telemetry.events("span_begin")}
+            self.assertTrue(
+                all(spans[e["span"]]["name"] == "transport.resplit"
+                    for e in trail)
+            )
+
+    def test_injected_compile_failure_emits_fallback_event(self):
+        with _EventsLevel():
+            inj = fault.FaultInjector(seed=0).error_in("fusion.compile", times=1)
+            x = ht.arange(24, dtype=ht.float32, split=0)
+            with fault.injected(inj):
+                _ = ((x * 3.0) + 1.0).larray
+            reasons = [e["reason"] for e in telemetry.events("fallback")]
+            self.assertIn("compile_error", reasons)
+            # the failed compile closed its compile_begin with ok=False
+            ends = telemetry.events("compile_end")
+            self.assertTrue(any(e.get("ok") is False for e in ends))
+
+    def test_warning_carries_blame_event_id(self):
+        prev_guard = guard.set_mode("warn")
+        try:
+            with _EventsLevel():
+                x = ht.arange(24, dtype=ht.float32, split=0)
+                z = ht.log(x - 100.0)  # negative operand: chain-introduced NaN
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    _ = z.larray
+                trips = [
+                    w.message for w in caught
+                    if issubclass(w.category, guard.NonFiniteWarning)
+                ]
+                self.assertTrue(trips)
+                eid = trips[0].event_id
+                self.assertIsNotNone(eid)
+                blames = telemetry.events("guard_blame")
+                self.assertTrue(any(e["seq"] == eid for e in blames))
+        finally:
+            guard.set_mode(prev_guard)
+
+    def test_stall_detector_events(self):
+        with _EventsLevel():
+            stalls = []
+            det = fault.StallDetector(timeout=0.15, on_stall=stalls.append)
+            det.start()
+            try:
+                det.beat()
+                with det.pause():
+                    pass
+                with telemetry.span("user.stalled_work"):
+                    deadline = __import__("time").monotonic() + 5.0
+                    while not stalls and __import__("time").monotonic() < deadline:
+                        __import__("time").sleep(0.02)
+            finally:
+                det.stop()
+            self.assertTrue(stalls)
+            self.assertTrue(telemetry.events("heartbeat"))
+            self.assertTrue(telemetry.events("stall_pause"))
+            self.assertTrue(telemetry.events("stall_resume"))
+            stall_events = telemetry.events("stall")
+            self.assertTrue(stall_events)
+            self.assertGreaterEqual(stall_events[0]["quiet_s"], 0.15)
+            # the watchdog thread saw the workload's open span
+            self.assertIn(
+                "user.stalled_work",
+                [s["name"] for s in stall_events[0]["open_spans"]],
+            )
+
+
+class TestCostLedger(TestCase):
+    def setUp(self):
+        _reset_counters()
+        telemetry.reset_programs()
+
+    def tearDown(self):
+        _reset_counters()
+        telemetry.reset_programs()
+
+    @unittest.skipUnless(fusion.enabled(), "fusion engine disabled")
+    def test_fused_moments_program_is_ledgered(self):
+        x = ht.array(
+            np.random.default_rng(0).random((64, 16)).astype(np.float32),
+            split=0, comm=self.comm,
+        )
+        _ = ht.mean(x)
+        _ = float(ht.var(x).larray) if hasattr(ht.var(x), "larray") else None
+        progs = [p for p in telemetry.programs() if p["kind"] == "fused"]
+        self.assertTrue(progs)
+        biggest = max(progs, key=lambda p: p["flops"])
+        self.assertGreater(biggest["flops"], 0.0)
+        self.assertGreater(biggest["hbm_bytes"], 0.0)
+        self.assertGreaterEqual(biggest["ops"], 1)
+        self.assertEqual(biggest["mesh"], {"devices": self.comm.size})
+
+    @unittest.skipUnless(len(jax.devices()) >= 4, "needs >= 4 devices")
+    def test_ring_matmul_program_is_ledgered(self):
+        comm = _mesh(4)
+        rng = np.random.default_rng(1)
+        m = k = n = 32
+        A = rng.random((m, k)).astype(np.float32)
+        B = rng.random((k, n)).astype(np.float32)
+        a = ht.array(A, split=0, comm=comm)
+        b = ht.array(B, split=0, comm=comm)  # row×row is the `ag` case
+        overlap.set_mode("ring")
+        try:
+            with fusion.fuse(False):
+                out = ht.matmul(a, b)
+        finally:
+            overlap.set_mode(None)
+        self.assertEqual(overlap.stats()["last"]["schedule"], "ring_ag")
+        np.testing.assert_allclose(out.numpy(), A @ B, rtol=2e-5, atol=2e-5)
+        rings = [p for p in telemetry.programs() if p["kind"] == "ring_matmul"]
+        self.assertTrue(rings)
+        self.assertEqual(rings[-1]["flops"], 2.0 * m * k * n)
+        self.assertGreater(rings[-1]["hbm_bytes"], 0.0)
+        self.assertEqual(rings[-1]["schedule"], "ring_ag")
+
+    @unittest.skipUnless(fusion.enabled(), "fusion engine disabled")
+    def test_cache_hit_counts_on_ledger_entry(self):
+        x = ht.arange(48, dtype=ht.float32, split=0)
+        _ = ((x + 1.0) * 2.0).larray
+        y = ht.arange(48, dtype=ht.float32, split=0)
+        _ = ((y + 1.0) * 2.0).larray  # same topology: compile-cache hit
+        progs = {p["fingerprint"]: p for p in telemetry.programs()}
+        self.assertTrue(
+            any(p["hits"] >= 1 for p in progs.values()),
+            f"no ledger entry saw a hit: {list(progs.values())}",
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()
