@@ -1,0 +1,221 @@
+"""Perf-regression harness over the checked-in BENCH_cb_r*.json trajectory.
+
+The cb rounds (``BENCH_cb_r02.json`` ... at the repo root) are the
+project's performance memory; until now nothing read them back, so a row
+could silently give up the speed a previous PR bought.  This module
+closes that loop:
+
+* :func:`load_rounds` reads every checked-in round document,
+* :func:`best_history` reduces them to the best (minimum) ``wall_s``
+  per row name — compared **backend-to-backend only** (a CPU smoke run
+  is never judged against the TPU trajectory; such rows report
+  ``no-history`` and pass, keeping the gate honest rather than vacuously
+  red on dev machines),
+* :func:`compare` judges a current measurement list row-by-row against
+  that best, with a per-row noise tolerance,
+* :func:`check` attaches the delta table to a cb suite document
+  (``doc["regression"]``) and returns the out-of-tolerance rows —
+  ``main.py --check-regression`` exits nonzero on any,
+* :func:`self_check` replays the gate on the trajectory itself (latest
+  round vs the best of the earlier ones) so CI proves the harness bites
+  without needing TPU hardware.
+
+Tolerance model: a row regresses when ``wall_s`` exceeds
+``max(best * (1 + tol), best + ABS_FLOOR_S)``.  The absolute floor keeps
+sub-millisecond rows (dispatch-latency dominated on both CPU and the
+tunnel) from flagging on scheduler jitter; the relative tolerance covers
+real kernels.  Rows whose checked-in notes document larger spreads carry
+explicit entries in :data:`TOLERANCE` — each one cites its source."""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# default relative tolerance: a real kernel may not lose more than 25%
+# against its best checked-in round
+DEFAULT_REL_TOL = 0.25
+# absolute jitter floor: deltas under 2 ms never flag (dispatch latency
+# noise on tiny rows — see e.g. r05 concatenate vs r04: +1.4 ms)
+ABS_FLOOR_S = 0.002
+
+# Per-row overrides, each justified by the row's own checked-in metadata:
+TOLERANCE = {
+    # r05 note: "measured 10-50 ms across runs — the spread is tunnel
+    # dispatch jitter over 50 dependent tiny steps, not kernel time"
+    "lanczos": 3.0,
+    # single-run whole-`.fit` walls including the estimator's
+    # n_iter/inertia host readbacks (their notes say so) — not
+    # slope-measured, so host scheduling rides the number
+    "kmeans": 0.4,
+    "kmedians": 0.4,
+    "kmedoids": 0.4,
+    # single-run with one deliberate host sync (qr.py breakdown check)
+    "tsqr_user_call": 0.4,
+}
+
+_ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")
+
+
+def repo_root() -> str:
+    return os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def load_rounds(root=None):
+    """Every checked-in round as ``(round_number, path, document)``,
+    oldest first."""
+    root = root or repo_root()
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_cb_r*.json"))):
+        m = _ROUND_RE.search(path)
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        out.append((int(m.group(1)), path, doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def best_history(rounds, backend, before_round=None):
+    """Best (minimum) ``wall_s`` per row name across the rounds matching
+    ``backend``; ``before_round`` restricts to strictly earlier rounds
+    (the self-check's baseline window)."""
+    best = {}
+    for rnum, _path, doc in rounds:
+        if doc.get("backend") != backend:
+            continue
+        if before_round is not None and rnum >= before_round:
+            continue
+        for m in doc.get("measurements", []):
+            w = m.get("wall_s")
+            name = m.get("name")
+            if w is None or name is None:
+                continue
+            cur = best.get(name)
+            if cur is None or w < cur["best_wall_s"]:
+                best[name] = {"best_wall_s": w, "round": rnum}
+    return best
+
+
+def compare(measurements, best):
+    """Judge ``measurements`` row-by-row against ``best``.  Returns
+    ``(rows, regressions)`` — every row gets a delta entry with status
+    ``ok`` / ``regression`` / ``no-history``."""
+    rows, bad = [], []
+    for m in measurements:
+        name = m.get("name")
+        w = m.get("wall_s")
+        if name is None or w is None:
+            continue
+        h = best.get(name)
+        if h is None:
+            rows.append({"name": name, "wall_s": w, "status": "no-history"})
+            continue
+        b = h["best_wall_s"]
+        tol = TOLERANCE.get(name, DEFAULT_REL_TOL)
+        limit = max(b * (1.0 + tol), b + ABS_FLOOR_S)
+        row = {
+            "name": name,
+            "wall_s": w,
+            "best_wall_s": b,
+            "best_round": h["round"],
+            "ratio": round(w / b, 4) if b > 0 else None,
+            "tolerance": tol,
+            "limit_s": round(limit, 6),
+            "status": "ok" if w <= limit else "regression",
+        }
+        rows.append(row)
+        if row["status"] == "regression":
+            bad.append(row)
+    return rows, bad
+
+
+def _print_table(rows, header):
+    print(header)
+    print(f"  {'row':<36}{'wall_s':>12}{'best':>12}{'ratio':>8}"
+          f"{'limit':>12}  status")
+    for r in rows:
+        if r["status"] == "no-history":
+            print(f"  {r['name']:<36}{r['wall_s']:>12.6f}{'-':>12}{'-':>8}"
+                  f"{'-':>12}  no-history")
+        else:
+            print(f"  {r['name']:<36}{r['wall_s']:>12.6f}"
+                  f"{r['best_wall_s']:>12.6f}{r['ratio']:>8.3f}"
+                  f"{r['limit_s']:>12.6f}  {r['status']}")
+
+
+def check(doc, root=None):
+    """Compare a cb suite document against the checked-in trajectory for
+    its backend, attach the delta table as ``doc["regression"]``, print
+    it, and return the out-of-tolerance rows."""
+    rounds = load_rounds(root)
+    backend = doc.get("backend", "cpu")
+    best = best_history(rounds, backend)
+    rows, bad = compare(doc.get("measurements", []), best)
+    doc["regression"] = {
+        "backend": backend,
+        "baseline_rounds": [r for r, _p, d in rounds
+                            if d.get("backend") == backend],
+        "rel_tolerance_default": DEFAULT_REL_TOL,
+        "abs_floor_s": ABS_FLOOR_S,
+        "rows": rows,
+        "regressions": [r["name"] for r in bad],
+    }
+    if not best:
+        print(f"check-regression: no checked-in {backend}-backend history — "
+              f"{len(rows)} row(s) pass as no-history "
+              f"(trajectory rounds are "
+              f"{sorted(set(d.get('backend') for _r, _p, d in rounds))})")
+    _print_table(rows, f"check-regression vs best {backend} history:")
+    if bad:
+        print(f"REGRESSION: {len(bad)} row(s) out of tolerance: "
+              + ", ".join(r["name"] for r in bad))
+    else:
+        print("check-regression: all rows within tolerance")
+    return bad
+
+
+def self_check(root=None):
+    """Replay the gate on the trajectory itself: the latest checked-in
+    round vs the best of the strictly earlier same-backend rounds.
+    Returns the out-of-tolerance rows (CI fails on any) — proving on
+    every run that the harness actually bites, with no hardware needed."""
+    rounds = load_rounds(root)
+    if len(rounds) < 2:
+        print("self-check: need at least two checked-in rounds")
+        return []
+    latest_num, latest_path, latest = rounds[-1]
+    backend = latest.get("backend", "cpu")
+    best = best_history(rounds, backend, before_round=latest_num)
+    rows, bad = compare(latest.get("measurements", []), best)
+    _print_table(
+        rows,
+        f"self-check: r{latest_num:02d} ({os.path.basename(latest_path)}) "
+        f"vs best of earlier {backend} rounds:",
+    )
+    if bad:
+        print(f"REGRESSION in checked-in trajectory: "
+              + ", ".join(r["name"] for r in bad))
+    else:
+        print(f"self-check OK: {len(rows)} rows within tolerance")
+    return bad
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--self-check", action="store_true",
+                    help="gate the latest checked-in round against the "
+                         "best of the earlier ones")
+    ap.add_argument("--root", default=None,
+                    help="repo root holding BENCH_cb_r*.json")
+    args = ap.parse_args()
+    if args.self_check:
+        sys.exit(1 if self_check(args.root) else 0)
+    ap.error("nothing to do (pass --self-check, or use main.py "
+             "--check-regression for a live run)")

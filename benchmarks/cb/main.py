@@ -11,6 +11,7 @@ import sys
 import cluster
 import config
 import fusion
+import history
 import linalg
 import manipulations
 import nn
@@ -88,6 +89,14 @@ if __name__ == "__main__":
         default=None,
         help="comma-separated subset: linalg,cluster,manipulations,nn,regression,fusion",
     )
+    ap.add_argument(
+        "--check-regression",
+        action="store_true",
+        help="after the run, compare each row against the best checked-in "
+             "BENCH_cb_r*.json value for this backend (per-row noise "
+             "tolerance; see history.py), attach the delta table to the "
+             "--out document, and exit nonzero on any out-of-tolerance row",
+    )
     args = ap.parse_args()
 
     suites = {
@@ -115,6 +124,11 @@ if __name__ == "__main__":
         "measurements": _monitor.measurements(),
         "derived": derive(_monitor.measurements()),
     }
+    regressions = []
+    if args.check_regression:
+        # attaches doc["regression"] (the per-row delta table) in place,
+        # so the --out document carries the verdict it was judged by
+        regressions = history.check(doc)
     print(json.dumps(doc))
     if args.out:
         with open(args.out, "w") as fh:
@@ -122,4 +136,4 @@ if __name__ == "__main__":
     if args.prom:
         with open(args.prom, "w") as fh:
             fh.write(_telemetry.export_prometheus())
-    sys.exit(0)
+    sys.exit(1 if regressions else 0)

@@ -1063,7 +1063,10 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
         telemetry.record_event("cache_hit", fingerprint=entry.fp)
         try:
             guard.fire("fusion.exec")
-            outs = entry.jitted(*vals)
+            # steady-state executions get the (sampled) measured wall
+            # clock; the miss path's first run is excluded — its wall is
+            # trace+compile time, already on the compile_end event
+            outs = telemetry.timed_call(entry.fp, entry.jitted, *vals)
             if fold:
                 outs, flag = outs[:-1], outs[-1]
         except Exception:

@@ -83,9 +83,11 @@ from typing import Any, Callable, Dict, List, Optional
 __all__ = [
     "current_span",
     "dump",
+    "ensure_program",
     "events",
     "clear_events",
     "export_prometheus",
+    "export_trace",
     "level",
     "open_spans",
     "postmortem",
@@ -93,16 +95,21 @@ __all__ = [
     "programs",
     "record_event",
     "record_program",
+    "record_timing",
     "register_group",
     "reset_all",
     "reset_group",
     "reset_programs",
+    "roofline_report",
     "set_capacity",
     "set_level",
+    "set_sample_every",
     "snapshot",
     "snapshot_group",
     "span",
     "telemetry_level",
+    "timed_call",
+    "timing_active",
 ]
 
 
@@ -265,32 +272,83 @@ def snapshot() -> Dict[str, Dict[str, Any]]:
 _METRIC_SAFE = re.compile(r"[^a-zA-Z0-9_]")
 
 
-def _prom_lines(prefix: str, value, lines: List[str]) -> None:
+def _prom_lines(prefix: str, value, lines: List[str], src: str = "") -> None:
     if isinstance(value, bool):
         value = int(value)
     if isinstance(value, (int, float)):
+        lines.append(f"# HELP {prefix} heat_tpu telemetry gauge {src or prefix}")
         lines.append(f"# TYPE {prefix} gauge")
         lines.append(f"{prefix} {value}")
         return
     if isinstance(value, dict):
         for k, v in value.items():
-            _prom_lines(f"{prefix}_{_METRIC_SAFE.sub('_', str(k))}", v, lines)
+            _prom_lines(
+                f"{prefix}_{_METRIC_SAFE.sub('_', str(k))}", v, lines,
+                src=f"{src}.{k}" if src else str(k),
+            )
     # None / strings / other payloads have no numeric exposition — skipped
 
 
+_LABEL_ESCAPES = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _label_escape(s) -> str:
+    return "".join(_LABEL_ESCAPES.get(c, c) for c in str(s))
+
+
+# per-program roofline gauges emitted for at most this many programs
+# (the heaviest by measured total time), keeping scrapes bounded
+_PROM_PROGRAMS_MAX = 16
+
+
+def _program_prom_lines(lines: List[str]) -> None:
+    """Labeled ``heat_tpu_program_*`` gauges for the measured programs:
+    calls/seconds plus the roofline attribution, keyed by
+    ``{fingerprint=...,kind=...}``."""
+    try:
+        from . import roofline
+
+        rows = roofline.report(programs(), top=_PROM_PROGRAMS_MAX)["rows"]
+    except Exception:  # attribution must never break a metrics scrape
+        return
+    fields = (
+        "calls", "total_s", "min_s", "p50_s", "achieved_gflops",
+        "achieved_gbps", "frac_compute_roofline", "frac_hbm_roofline",
+    )
+    for f in fields:
+        samples = []
+        for r in rows:
+            v = r.get(f)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            labels = (
+                f'fingerprint="{_label_escape(r["fingerprint"])}"'
+                f',kind="{_label_escape(r.get("kind") or "")}"'
+            )
+            samples.append(f"heat_tpu_program_{f}{{{labels}}} {v}")
+        if samples:
+            name = f"heat_tpu_program_{f}"
+            lines.append(f"# HELP {name} heat_tpu telemetry gauge "
+                         f"measured per-program {f}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.extend(samples)
+
+
 def export_prometheus() -> str:
-    """Text exposition format (one ``# TYPE`` + value line per numeric
-    leaf): every registered group flattened as
-    ``heat_tpu_<group>_<counter>``, nested dicts joined with ``_``, plus
-    recorder/ledger gauges.  Non-numeric fields are skipped."""
+    """Text exposition format (``# HELP`` + ``# TYPE gauge`` + one value
+    line per numeric leaf): every registered group flattened as
+    ``heat_tpu_<group>_<counter>`` (label-unsafe characters in group and
+    counter names escaped to ``_``; the ``# HELP`` line keeps the
+    original dotted path), plus labeled per-program
+    ``heat_tpu_program_*`` gauges for the measured roofline rows.
+    Non-numeric fields are skipped."""
     lines: List[str] = []
     for name in _GROUPS:
         _prom_lines(
-            f"heat_tpu_{_METRIC_SAFE.sub('_', name)}", snapshot_group(name), lines
+            f"heat_tpu_{_METRIC_SAFE.sub('_', name)}", snapshot_group(name),
+            lines, src=name,
         )
-    _prom_lines("heat_tpu_telemetry_events", len(_RING), lines)
-    _prom_lines("heat_tpu_telemetry_events_dropped", _DROPPED[0], lines)
-    _prom_lines("heat_tpu_telemetry_programs", len(_PROGRAMS), lines)
+    _program_prom_lines(lines)
     return "\n".join(lines) + "\n"
 
 
@@ -321,7 +379,7 @@ def set_capacity(n: int) -> int:
 
 # event keys the recorder itself owns; caller fields shadowing them are
 # re-keyed with an "x_" prefix instead of corrupting the envelope
-_RESERVED_FIELDS = frozenset(("seq", "ts", "kind", "span"))
+_RESERVED_FIELDS = frozenset(("seq", "ts", "kind", "span", "tid"))
 
 
 def record_event(kind: str, /, **fields) -> Optional[int]:
@@ -330,9 +388,10 @@ def record_event(kind: str, /, **fields) -> Optional[int]:
     Returns the event's sequence number, or ``None`` below ``events``
     level (the no-record gate is one integer compare — safe to call on
     hot paths unconditionally).  Events carry a monotonic ``ts``, the
+    recording thread's ident (``tid`` — the trace-export lane), the
     calling thread's innermost open span id (``span``), and the caller's
     ``fields`` (a field named like an envelope key — ``kind``/``seq``/
-    ``ts``/``span`` — is stored re-keyed as ``x_<name>``)."""
+    ``ts``/``span``/``tid`` — is stored re-keyed as ``x_<name>``)."""
     if _LEVEL < _EVENTS:
         return None
     seq = next(_SEQ)
@@ -344,6 +403,7 @@ def record_event(kind: str, /, **fields) -> Optional[int]:
         "ts": time.monotonic(),
         "kind": kind,
         "span": cur[-1].id if cur else None,
+        "tid": threading.get_ident(),
     }
     for k, v in fields.items():
         evt[f"x_{k}" if k in _RESERVED_FIELDS else k] = v
@@ -351,9 +411,14 @@ def record_event(kind: str, /, **fields) -> Optional[int]:
     return seq
 
 
-def events(kind: Optional[str] = None) -> List[dict]:
-    """The recorded events, oldest first; ``kind`` filters."""
+def events(kind: Optional[str] = None, since: Optional[int] = None) -> List[dict]:
+    """The recorded events, oldest first; ``kind`` filters.  ``since`` is
+    an incremental-read cursor: only events with a sequence number
+    strictly greater than it are returned, so an external poller can feed
+    the last ``seq`` it saw back in instead of re-scanning the ring."""
     got = list(_RING)
+    if since is not None:
+        got = [e for e in got if e["seq"] > since]
     if kind is not None:
         got = [e for e in got if e["kind"] == kind]
     return got
@@ -391,9 +456,11 @@ def postmortem(reason: str, **fields) -> None:
     """Automatic degradation dump: called on a guard ``raise``, an
     exec-error eager fallback, and a detected stall.  Records a
     ``postmortem`` event; when ``HEAT_TPU_TELEMETRY_DUMP`` names a path,
-    the full :func:`dump` document is written there (a repeated
-    postmortem in one process appends ``.2``, ``.3``, ... instead of
-    overwriting the first trail).  No-op below ``events`` level."""
+    the full :func:`dump` document is written there with a sibling
+    ``<path>.trace.json`` Chrome-trace rendering (:func:`export_trace`)
+    for Perfetto (a repeated postmortem in one process appends ``.2``,
+    ``.3``, ... instead of overwriting the first trail).  No-op below
+    ``events`` level."""
     if _LEVEL < _EVENTS:
         return
     record_event("postmortem", reason=reason, **fields)
@@ -407,6 +474,7 @@ def postmortem(reason: str, **fields) -> None:
             n += 1
             final = f"{path}.{n}"
         dump(final)
+        export_trace(f"{final}.trace.json")
     except OSError:  # a broken dump path must never mask the real failure
         pass
 
@@ -597,7 +665,22 @@ def record_program(
     }
     _PROGRAMS.move_to_end(fp)
     while len(_PROGRAMS) > _PROGRAMS_MAX:
-        _PROGRAMS.popitem(last=False)
+        old, _ = _PROGRAMS.popitem(last=False)
+        _TIMINGS.pop(old, None)
+
+
+def ensure_program(fp: Optional[str], **kwargs) -> None:
+    """Ledger a program only if its fingerprint is new; count a hit
+    otherwise.  The transport kernels call this per execution — their jit
+    cache is internal (``lru_cache`` around the shard_map build), so
+    compiles-vs-hits is approximated as first-sighting-vs-rest."""
+    if fp is None or _LEVEL < _COUNTERS:
+        return
+    got = _PROGRAMS.get(fp)
+    if got is None:
+        record_program(fp, **kwargs)
+    else:
+        got["hits"] += 1
 
 
 def program_hit(fp: Optional[str]) -> None:
@@ -613,13 +696,127 @@ def programs() -> List[dict]:
     """The per-program cost ledger, oldest entry first: one dict per
     compiled program with ``fingerprint``, ``kind``, ``n_roots``,
     ``ops``, ``flops``, ``hbm_bytes``, ``mesh``, ``compiles`` and
-    ``hits``."""
-    return [dict(v) for v in _PROGRAMS.values()]
+    ``hits`` — plus, for programs with measured executions, the wall
+    clocks ``calls``, ``total_s``, ``min_s`` and ``p50_s``."""
+    return [dict(v, **_timing_view(fp)) for fp, v in _PROGRAMS.items()]
 
 
 def reset_programs() -> None:
     """Drop the cost ledger (tests/benchmarks)."""
     _PROGRAMS.clear()
+    _TIMINGS.clear()
+
+
+# ------------------------------------------------ measured program timing
+# The ledger above is PREDICTED work; this side table holds MEASURED wall
+# clocks from the live executable call sites (fusion hit path, transport
+# tile loops, the ring matmul).  Kept out of the entry dicts so a
+# re-record of a fingerprint (refreshed estimate) never loses history.
+
+_TIMINGS: Dict[str, dict] = {}
+_TIMING_SAMPLES = 64  # per-program reservoir backing the p50 estimate
+_TICK = itertools.count()
+
+
+def _env_sample_every() -> int:
+    raw = os.environ.get("HEAT_TPU_TELEMETRY_SAMPLE", "").strip()
+    try:
+        n = int(raw) if raw else 16
+    except ValueError:
+        n = 16
+    return max(n, 1)
+
+
+_SAMPLE_EVERY = _env_sample_every()
+
+
+def set_sample_every(n: int) -> int:
+    """Set the ``counters``-level sampling period (every Nth executable
+    call is wall-clocked; ``HEAT_TPU_TELEMETRY_SAMPLE``, default 16).
+    Returns the previous period."""
+    global _SAMPLE_EVERY
+    prev = _SAMPLE_EVERY
+    _SAMPLE_EVERY = max(int(n), 1)
+    return prev
+
+
+def timing_active() -> bool:
+    """Whether THIS executable call should be wall-clocked: never below
+    ``counters``, every call at ``events`` and above, every Nth call at
+    ``counters`` — a sampled ``block_until_ready`` keeps the default-level
+    tax under the cb ``telemetry_overhead`` bar while still accumulating
+    honest steady-state samples."""
+    if _LEVEL < _COUNTERS:
+        return False
+    if _LEVEL >= _EVENTS:
+        return True
+    return next(_TICK) % _SAMPLE_EVERY == 0
+
+
+def record_timing(fp: Optional[str], dur_s: float) -> None:
+    """Accumulate one measured wall clock under a program fingerprint
+    (``calls``/``total_s``/``min_s`` plus a bounded sample reservoir for
+    ``p50_s``).  External timers — e.g. a serving layer that measures its
+    own request walls — may call this directly."""
+    if fp is None or _LEVEL < _COUNTERS:
+        return
+    t = _TIMINGS.get(fp)
+    if t is None:
+        t = _TIMINGS[fp] = {
+            "calls": 0,
+            "total_s": 0.0,
+            "min_s": float("inf"),
+            "samples": deque(maxlen=_TIMING_SAMPLES),
+        }
+    t["calls"] += 1
+    t["total_s"] += dur_s
+    if dur_s < t["min_s"]:
+        t["min_s"] = dur_s
+    t["samples"].append(dur_s)
+
+
+def _timing_view(fp: str) -> dict:
+    t = _TIMINGS.get(fp)
+    if t is None or not t["calls"]:
+        return {}
+    ordered = sorted(t["samples"])
+    return {
+        "calls": t["calls"],
+        "total_s": round(t["total_s"], 6),
+        "min_s": round(t["min_s"], 6),
+        "p50_s": round(ordered[len(ordered) // 2], 6),
+    }
+
+
+def timed_call(fp: Optional[str], fn: Callable, *args):
+    """Run ``fn(*args)`` (a jitted executable); when the sampling gate
+    fires, block until the outputs are ready and accumulate the wall
+    clock under ``fp``.  With ``fp=None`` or an idle gate this is a plain
+    call — async dispatch is only serialized on sampled calls."""
+    if fp is None or not timing_active():
+        return fn(*args)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:  # timing must never break the computation
+        pass
+    record_timing(fp, time.perf_counter() - t0)
+    return out
+
+
+def roofline_report(top: Optional[int] = None, peaks: Optional[dict] = None) -> dict:
+    """Measured-vs-peak attribution for every ledgered program with
+    measured time: ``{"device", "peaks", "rows", "memory_bound_tail"}``,
+    rows sorted by total measured time, each carrying achieved GFLOP/s
+    and GB/s, the roofline fractions, and a compute/memory-bound verdict
+    (``unknown-peak`` when the device peaks are unknown — see
+    :mod:`heat_tpu.core.roofline` and ``HEAT_TPU_PEAKS``)."""
+    from . import roofline
+
+    return roofline.report(programs(), top=top, peaks=peaks)
 
 
 def reset() -> None:
@@ -627,6 +824,104 @@ def reset() -> None:
     reset_all()
     clear_events()
     reset_programs()
+
+
+# --------------------------------------------------------------- trace export
+
+# event keys owned by the recorder envelope / span identity; everything
+# else a span or event carries becomes Chrome-trace ``args``
+_TRACE_ENVELOPE = frozenset(("seq", "ts", "kind", "span", "tid", "id",
+                             "name", "parent"))
+
+
+def export_trace(file=None) -> List[dict]:
+    """Render the flight recorder as Chrome-trace JSON (the array-of-
+    events form Perfetto's legacy JSON importer loads): one ``B``/``E``
+    duration-event pair per span (one lane per recording thread, so
+    nesting renders as a flame), and an ``i`` instant event for every
+    non-span event — guard blames, OOM retries, fallbacks, dispatch
+    decisions, stall heartbeats.  Timestamps are microseconds relative to
+    the oldest recorded event.  Spans still open at export are closed at
+    the last recorded timestamp with ``status: open``; a span whose begin
+    was evicted from the ring is synthesized from the end event's
+    recorded duration (its nesting may render approximate).  Returns the
+    event list; ``file`` (path or handle) additionally writes it as
+    JSON."""
+    evs = events()
+    pid = os.getpid()
+    out: List[dict] = []
+    lanes: Dict[int, int] = {}
+
+    def lane(raw_tid) -> int:
+        got = lanes.get(raw_tid)
+        if got is None:
+            got = lanes[raw_tid] = len(lanes)
+            out.append({
+                "ph": "M", "ts": 0, "pid": pid, "tid": got,
+                "name": "thread_name", "cat": "__metadata",
+                "args": {"name": f"thread-{got}"},
+            })
+        return got
+
+    t0 = evs[0]["ts"] if evs else 0.0
+
+    def us(ts: float) -> float:
+        return round((ts - t0) * 1e6, 3)
+
+    begun: Dict[int, dict] = {}
+    for e in evs:
+        tid = lane(e.get("tid", 0))
+        args = {k: v for k, v in e.items() if k not in _TRACE_ENVELOPE}
+        kind = e["kind"]
+        if kind == "span_begin":
+            begun[e["id"]] = e
+            out.append({"ph": "B", "ts": us(e["ts"]), "pid": pid, "tid": tid,
+                        "cat": "span", "name": e["name"], "args": args})
+        elif kind == "span_end":
+            if e["id"] not in begun:
+                out.append({
+                    "ph": "B",
+                    "ts": us(e["ts"] - float(e.get("dur_s") or 0.0)),
+                    "pid": pid, "tid": tid, "cat": "span", "name": e["name"],
+                    "args": {"synthesized": "begin evicted from ring"},
+                })
+            begun.pop(e["id"], None)
+            out.append({"ph": "E", "ts": us(e["ts"]), "pid": pid, "tid": tid,
+                        "cat": "span", "name": e["name"], "args": args})
+        else:
+            out.append({"ph": "i", "s": "t", "ts": us(e["ts"]), "pid": pid,
+                        "tid": tid, "cat": "event", "name": kind,
+                        "args": args})
+    if evs:
+        t_last = us(evs[-1]["ts"])
+        # close innermost-first so each lane's B/E stack stays balanced
+        for e in reversed(list(begun.values())):
+            out.append({"ph": "E", "ts": t_last, "pid": pid,
+                        "tid": lane(e.get("tid", 0)), "cat": "span",
+                        "name": e["name"], "args": {"status": "open"}})
+    if isinstance(file, (str, os.PathLike)):
+        with open(file, "w") as fh:
+            json.dump(out, fh, indent=1, default=repr)
+    elif file is not None:
+        json.dump(out, file, indent=1, default=repr)
+    return out
+
+
+# The recorder/ledger's own health gauges, registered as a group so they
+# ride snapshot()/export_prometheus() like any subsystem group (the
+# `events_dropped` count is the ring's eviction pressure — a poller
+# seeing it grow between scrapes knows its `since=` cursor lost data).
+register_group(
+    "telemetry",
+    {},
+    extra=lambda: {
+        "level": level(),
+        "capacity": _RING.maxlen,
+        "events": len(_RING),
+        "events_dropped": _DROPPED[0],
+        "programs": len(_PROGRAMS),
+    },
+)
 
 
 # ------------------------------------------------------------- convenience

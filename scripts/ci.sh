@@ -38,6 +38,13 @@
 #                 laws, injected-fault event trail, well-formed
 #                 Prometheus export), then a cb smoke run with --prom
 #                 proving a full run exports a valid snapshot
+#  13. roofline  — roofline attribution + perf gate (ISSUE 9): the
+#                 roofline/history test files, a Chrome-trace export
+#                 shape check (every event carries ph/ts/pid/tid, spans
+#                 nest as B/E pairs), the history.py --self-check gate
+#                 on the checked-in BENCH_cb_r*.json trajectory, and a
+#                 cb smoke run under --check-regression proving the
+#                 delta table lands in the --out document
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -50,7 +57,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/12 suite (8-device mesh)"
+say "1/13 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -59,21 +66,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/12 core subset (4-device mesh)"
+say "2/13 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/12 parity audit (exits nonzero on any gap)"
+say "3/13 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/12 multi-chip dry-run"
+say "4/13 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/12 cb smoke"
+say "5/13 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -82,10 +89,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/12 copycheck"
+say "6/13 copycheck"
 python scripts/copycheck.py
 
-say "7/12 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/13 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -101,10 +108,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/12 fusion retrace guard (second call must hit the compile cache)"
+say "8/13 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/12 guardrails (fault injection + strict-guard retrace check)"
+say "9/13 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -115,7 +122,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/12 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/13 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -123,13 +130,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/12 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/13 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/12 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/13 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -144,16 +151,68 @@ python -m pytest -q -p no:cacheprovider \
 python - <<'EOF'
 lines = open("/tmp/ci_cb_tel.prom").read().splitlines()
 typed = {l.split()[2] for l in lines if l.startswith("# TYPE ")}
+helped = {l.split()[2] for l in lines if l.startswith("# HELP ")}
 samples = [l for l in lines if l and not l.startswith("#")]
 assert samples, "empty Prometheus export"
 for l in samples:
-    name, value = l.split()
-    assert name in typed, f"untyped sample {name}"
+    name, value = l.rsplit(" ", 1)
+    family = name.split("{", 1)[0]  # labeled heat_tpu_program_* samples
+    assert family in typed, f"untyped sample {family}"
+    assert family in helped, f"undocumented sample {family}"
     float(value)
 for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
              "heat_tpu_overlap_calls", "heat_tpu_telemetry_events"):
     assert want in typed, f"missing metric family {want}"
 print(f"cb --prom export OK: {len(samples)} gauges")
+EOF
+
+say "13/13 roofline attribution + perf-regression gate"
+# measured per-program accounting, device peaks, trace export, and the
+# history gate: the test files first, then the live artifacts — a
+# Chrome-trace export from a real run must be Perfetto-shaped, the
+# checked-in trajectory must pass its own gate (proving the harness
+# bites without hardware), and a cb run under --check-regression must
+# carry the delta table in its --out document
+python -m pytest -q -p no:cacheprovider \
+  tests/test_roofline.py tests/test_cb_history.py 2>&1 | tee /tmp/ci_roofline.log
+python - <<'EOF'
+import json
+import heat_tpu as ht
+from heat_tpu.core import telemetry
+
+prev = telemetry.set_level("events")
+x = ht.arange(2048, dtype=ht.float32, split=0)
+for _ in range(2):
+    _ = ((x + 1.0) * 2.0 - 0.5).larray
+trace = telemetry.export_trace("/tmp/ci_trace.json")
+telemetry.set_level(prev)
+
+loaded = json.load(open("/tmp/ci_trace.json"))
+assert isinstance(loaded, list) and loaded, "trace export not a JSON array"
+for e in loaded:
+    for key in ("ph", "ts", "pid", "tid"):
+        assert key in e, f"trace event missing {key}: {e}"
+begins = [e for e in loaded if e["ph"] == "B"]
+ends = [e for e in loaded if e["ph"] == "E"]
+assert begins and len(begins) == len(ends), "unbalanced span B/E pairs"
+assert any(e["ph"] == "i" for e in loaded), "no instant events in trace"
+rows = telemetry.roofline_report()["rows"]
+assert any(r["kind"] == "fused" and r["calls"] >= 1 for r in rows), \
+    "no measured fused program in roofline report"
+print(f"trace export OK: {len(loaded)} events, "
+      f"{len(begins)} spans, {len(rows)} measured programs")
+EOF
+python benchmarks/cb/history.py --self-check
+( cd benchmarks/cb && python main.py --only manipulations \
+  --check-regression --out /tmp/ci_cb_reg.json )
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/ci_cb_reg.json"))
+reg = doc["regression"]
+assert reg["rows"], "check-regression attached an empty delta table"
+assert not reg["regressions"], f"regressions on smoke run: {reg['regressions']}"
+print(f"check-regression OK: {len(reg['rows'])} rows judged "
+      f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
 say "CI GREEN"

@@ -123,15 +123,20 @@ class TestRegistryLaws(TestCase):
         text = telemetry.export_prometheus()
         lines = [ln for ln in text.splitlines() if ln]
         self.assertTrue(lines)
-        names = set()
+        helped, typed = set(), set()
         for ln in lines:
-            if ln.startswith("# TYPE "):
+            if ln.startswith("# HELP "):
+                helped.add(ln.split(" ")[2])
+            elif ln.startswith("# TYPE "):
                 _, _, metric, mtype = ln.split(" ")
                 self.assertEqual(mtype, "gauge")
-                names.add(metric)
+                typed.add(metric)
             else:
+                self.assertFalse(ln.startswith("#"))  # no stray comments
                 metric, value = ln.rsplit(" ", 1)
-                self.assertIn(metric, names)  # every sample was typed
+                family = metric.split("{", 1)[0]  # labeled program samples
+                self.assertIn(family, typed)   # every sample was typed
+                self.assertIn(family, helped)  # ... and documented
                 float(value)  # every sample is numeric
         for expected in (
             "heat_tpu_fusion_misses",
@@ -139,7 +144,49 @@ class TestRegistryLaws(TestCase):
             "heat_tpu_overlap_by_schedule_gspmd",
             "heat_tpu_telemetry_events",
         ):
-            self.assertIn(expected, names)
+            self.assertIn(expected, typed)
+
+    def test_prometheus_golden_format(self):
+        # one counter, golden exposition: metric-unsafe characters in the
+        # group/counter names escape to `_`, the HELP line keeps the
+        # original dotted path, TYPE precedes the sample
+        telemetry.register_group("weird.group", {"hit rate%": 3})
+        try:
+            text = telemetry.export_prometheus()
+        finally:
+            telemetry._GROUPS.pop("weird.group", None)
+        golden = (
+            "# HELP heat_tpu_weird_group_hit_rate_ "
+            "heat_tpu telemetry gauge weird.group.hit rate%\n"
+            "# TYPE heat_tpu_weird_group_hit_rate_ gauge\n"
+            "heat_tpu_weird_group_hit_rate_ 3"
+        )
+        self.assertIn(golden, text)
+        self.assertTrue(text.endswith("\n"))
+
+    def test_snapshot_has_telemetry_group(self):
+        with _EventsLevel():
+            telemetry.record_event("probe")
+            snap = telemetry.snapshot()
+        self.assertIn("telemetry", snap)
+        tele = snap["telemetry"]
+        self.assertEqual(tele["level"], "events")
+        self.assertEqual(tele["events"], 1)
+        self.assertEqual(tele["capacity"], telemetry._RING.maxlen)
+        self.assertIn("events_dropped", tele)
+        self.assertIn("programs", tele)
+
+    def test_snapshot_counts_dropped_events(self):
+        prev_cap = telemetry.set_capacity(4)
+        try:
+            with _EventsLevel():
+                for i in range(10):
+                    telemetry.record_event("probe", i=i)
+                self.assertEqual(
+                    telemetry.snapshot()["telemetry"]["events_dropped"], 6
+                )
+        finally:
+            telemetry.set_capacity(prev_cap)
 
 
 class TestFlightRecorder(TestCase):
@@ -159,6 +206,35 @@ class TestFlightRecorder(TestCase):
                 self.assertEqual(ts, sorted(ts))
             finally:
                 telemetry.set_capacity(prev_cap)
+
+    def test_events_since_cursor(self):
+        with _EventsLevel():
+            seqs = [telemetry.record_event("probe", i=i) for i in range(6)]
+            # an external poller feeds back the last seq it saw
+            got = telemetry.events(since=seqs[3])
+            self.assertEqual([e["i"] for e in got], [4, 5])
+            self.assertEqual(telemetry.events("probe", since=seqs[-1]), [])
+            # since=None is the full ring (back-compat)
+            self.assertEqual(len(telemetry.events("probe")), 6)
+
+    def test_events_carry_thread_ident(self):
+        with _EventsLevel():
+            telemetry.record_event("probe")
+            got = {}
+
+            def worker():
+                telemetry.record_event("probe")
+                got["tid"] = threading.get_ident()
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join(timeout=5)
+            evts = telemetry.events("probe")
+            self.assertEqual(evts[0]["tid"], threading.get_ident())
+            self.assertEqual(evts[1]["tid"], got["tid"])
+            # a caller field named like an envelope key is re-keyed
+            telemetry.record_event("probe", tid="shadow")
+            self.assertEqual(telemetry.events("probe")[-1]["x_tid"], "shadow")
 
     def test_off_records_nothing(self):
         prev = telemetry.set_level("off")
@@ -277,6 +353,79 @@ class TestSpans(TestCase):
             end = telemetry.events("span_end")[-1]
             self.assertEqual(end["status"], "error")
             self.assertEqual(end["error"], "ValueError")
+
+    def test_decorator_preserves_metadata(self):
+        @telemetry.span("probe.meta")
+        def documented(n):
+            """Adds one."""
+            return n + 1
+
+        self.assertEqual(documented.__name__, "documented")
+        self.assertEqual(documented.__doc__, "Adds one.")
+        self.assertEqual(documented.__wrapped__(41), 42)
+
+    def test_decorated_raise_records_error_status(self):
+        @telemetry.span("probe.meta.err")
+        def boom():
+            raise KeyError("k")
+
+        with _EventsLevel():
+            with self.assertRaises(KeyError):
+                boom()
+            end = telemetry.events("span_end")[-1]
+            self.assertEqual(end["name"], "probe.meta.err")
+            self.assertEqual(end["status"], "error")
+            self.assertEqual(end["error"], "KeyError")
+
+    def test_postmortem_dump_under_concurrent_spans(self):
+        # two threads holding open spans while a postmortem fires: the
+        # dump must list BOTH open spans, a sibling Chrome trace must be
+        # written, and a second postmortem in the same process must take
+        # the .2 suffix instead of overwriting the first trail
+        import json
+        import os
+        import tempfile
+
+        with _EventsLevel():
+            entered = threading.Event()
+            release = threading.Event()
+
+            def worker():
+                with telemetry.span("worker.holding"):
+                    entered.set()
+                    release.wait(timeout=5)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            try:
+                self.assertTrue(entered.wait(timeout=5))
+                with tempfile.TemporaryDirectory() as td:
+                    path = os.path.join(td, "pm.json")
+                    os.environ["HEAT_TPU_TELEMETRY_DUMP"] = path
+                    try:
+                        with telemetry.span("main.holding"):
+                            telemetry.postmortem("test_reason", detail=1)
+                            telemetry.postmortem("test_reason_again")
+                    finally:
+                        del os.environ["HEAT_TPU_TELEMETRY_DUMP"]
+                    doc = json.load(open(path))
+                    names = [s["name"] for s in doc["open_spans"]]
+                    self.assertIn("worker.holding", names)
+                    self.assertIn("main.holding", names)
+                    self.assertTrue(os.path.exists(path + ".trace.json"))
+                    trace = json.load(open(path + ".trace.json"))
+                    self.assertTrue(
+                        all("ph" in e and "ts" in e for e in trace)
+                    )
+                    # never-overwrite: the second trail took .2
+                    self.assertTrue(os.path.exists(path + ".2"))
+                    self.assertTrue(os.path.exists(path + ".2.trace.json"))
+                    # ... and the first trail still ends at its own event
+                    self.assertEqual(doc["events"][-1]["reason"],
+                                     "test_reason")
+            finally:
+                release.set()
+                t.join(timeout=5)
 
 
 @unittest.skipUnless(fusion.enabled(), "fusion engine disabled")
