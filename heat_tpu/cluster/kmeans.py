@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dndarray import DNDarray
-from ..core import telemetry, types
+from ..core import memtrack, telemetry, types
 from ..ops.cdist import cdist as ops_cdist
 from ..spatial import distance
 from ._kcluster import _KCluster
@@ -374,19 +374,11 @@ def _pack_lanes(arr):
     # the unpacked loop rather than OOM — packing at ingest (loader level)
     # is the path for arrays near the HBM ceiling
     dev = next(iter(arr.devices()))
-    # the array is sharded over the mesh: memory budgets are per device
+    # the array is sharded over the mesh: memory budgets are per device;
+    # the unified reader reports the TIGHTEST device (None where the
+    # backend has no stats — e.g. through remote TPU tunnels)
     n_dev = max(1, len(arr.devices()))
-    stats = None
-    try:
-        stats = dev.memory_stats()  # None through remote TPU tunnels
-    except Exception:
-        pass
-    free = None
-    if stats:
-        limit = stats.get("bytes_limit")
-        in_use = stats.get("bytes_in_use")
-        if limit is not None and in_use is not None:
-            free = limit - in_use
+    free = memtrack.min_free_bytes()
     if free is not None:
         if free < arr.size * 2 // n_dev + (1 << 30):
             return None

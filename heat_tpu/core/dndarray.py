@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from . import devices, types
+from . import devices, memtrack, types
 from .devices import Device
 from ..parallel import transport
 from ..parallel.mesh import MeshComm, sanitize_comm
@@ -192,6 +192,8 @@ class DNDarray:
         self.__comm = comm
         self.__balanced = balanced
         self.__lshape_map = None
+        if array is not None:  # LazyDNDarray wraps a pending expression
+            memtrack.register_buffer(array, tag="leaf", split=split)
 
     # ------------------------------------------------------------ properties
     @property
@@ -213,6 +215,7 @@ class DNDarray:
     def larray(self, array: jax.Array):
         self.__array = array
         self._invalidate_halos()
+        memtrack.register_buffer(array, tag="leaf", split=self.__split)
 
     def _invalidate_halos(self) -> None:
         """Drop cached halo slabs; they are only valid until the next mutation
@@ -472,17 +475,23 @@ class DNDarray:
                 object.__setattr__(self, "_DNDarray__array", fused)
                 if self.__dict__.get("_expr") is not None:
                     object.__setattr__(self, "_expr", None)
+                memtrack.register_buffer(fused, tag="output", split=axis)
             else:
                 # a pending fused expression may hold this buffer as a DAG
                 # leaf; donating it would make that chain's later
                 # materialization a use-after-free — fall back to a
                 # non-donating move then
+                donate = safe_to_donate(self.__array)
+                if donate:
+                    memtrack.tag_buffer(self.__array, "donated")
                 self.__array = transport.tiled_resplit(
                     self.__array, self.__gshape, self.__split, axis, self.__comm,
-                    donate=safe_to_donate(self.__array),
+                    donate=donate,
                 )
+                memtrack.register_buffer(self.__array, tag="output", split=axis)
         else:
             self.__array = _to_physical(self.larray, self.__gshape, axis, self.__comm)
+            memtrack.register_buffer(self.__array, tag="output", split=axis)
         self.__split = axis
         self.__lshape_map = None
         self._invalidate_halos()

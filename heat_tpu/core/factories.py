@@ -21,7 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import devices, types
+from . import devices, memtrack, types
 from .dndarray import DNDarray, _physical_dim, _to_physical
 from ..parallel.mesh import MeshComm, sanitize_comm
 from .stride_tricks import sanitize_axis, sanitize_shape
@@ -62,6 +62,10 @@ def _finalize(
     gshape = tuple(garray.shape)
     garray = _to_physical(garray, gshape, split, comm)
     heat_type = types.canonical_heat_type(garray.dtype) if dtype is None else dtype
+    # every factory funnels here: ledger the buffer NOW so the creation
+    # site is the user's factory call, not the DNDarray ctor (the ctor's
+    # own registration dedupes to a rebind)
+    memtrack.register_buffer(garray, tag="leaf", split=split)
     return DNDarray(garray, gshape, heat_type, split, device, comm)
 
 

@@ -61,7 +61,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import guard, telemetry, types
+from . import guard, memtrack, telemetry, types
 from .dndarray import DNDarray, _physical_dim
 from .guard import NonFiniteError
 
@@ -163,10 +163,26 @@ def op_name(fn: Callable) -> str:
 # buffer to the transport engine's donating all-to-all.
 
 _PINNED: "dict[int, int]" = {}
+# buf_id -> weakrefs to the pinning Exprs; diagnostic shadow of _PINNED
+# that lets memtrack's leak detector tell "pin whose owner is gone but the
+# finalize never fired" from a legitimately live pin
+_PIN_OWNERS: "dict[int, list]" = {}
 
 
 def _unpin(buf_id: int) -> None:
     n = _PINNED.get(buf_id, 0) - 1
+    owners = _PIN_OWNERS.get(buf_id)
+    if owners:
+        # drop a dead owner ref if one exists (this finalize just killed
+        # its Expr), else the newest — the count is what's authoritative
+        for i, r in enumerate(owners):
+            if r() is None:
+                del owners[i]
+                break
+        else:
+            owners.pop()
+        if not owners:
+            _PIN_OWNERS.pop(buf_id, None)
     if n > 0:
         _PINNED[buf_id] = n
     else:
@@ -176,7 +192,22 @@ def _unpin(buf_id: int) -> None:
 def _pin(expr: "Expr", value) -> None:
     buf_id = id(value)
     _PINNED[buf_id] = _PINNED.get(buf_id, 0) + 1
+    _PIN_OWNERS.setdefault(buf_id, []).append(weakref.ref(expr))
     weakref.finalize(expr, _unpin, buf_id)
+    memtrack.tag_buffer(value, "pinned")
+
+
+def pin_leaks() -> "list[dict]":
+    """Pins whose owning Exprs are (partly) gone: for each pinned buffer,
+    compare the live-owner count against the pin count — a shortfall means
+    an Expr died without its finalize releasing the pin (the leak class
+    ``telemetry.leaks()`` exists to catch).  Empty in a healthy process."""
+    out = []
+    for buf_id, count in _PINNED.items():
+        live = sum(1 for r in _PIN_OWNERS.get(buf_id, ()) if r() is not None)
+        if live < count:
+            out.append({"buf_id": buf_id, "pins": count, "live_owners": live})
+    return out
 
 
 def safe_to_donate(value) -> bool:
@@ -1123,6 +1154,7 @@ class LazyDNDarray(DNDarray):
             # drops its expression reference, so the pin dies with the
             # last consumer rather than with this handle.
             expr.leafify(value, self.gshape)
+            memtrack.register_buffer(value, tag="output", split=self.split)
             _pin(expr, value)
             object.__setattr__(self, "_DNDarray__array", value)
             object.__setattr__(self, "_expr", None)
@@ -1196,6 +1228,7 @@ def materialize_all(*arrays):
         for x, value in zip(group, outs):
             expr = x._expr
             expr.leafify(value, x.gshape)
+            memtrack.register_buffer(value, tag="output", split=x.split)
             _pin(expr, value)
             object.__setattr__(x, "_DNDarray__array", value)
             object.__setattr__(x, "_expr", None)

@@ -10,17 +10,34 @@ from .dndarray import DNDarray
 
 __all__ = ["copy", "sanitize_memory_layout"]
 
+_JIT_COPY = None
+
 
 def copy(x: DNDarray) -> DNDarray:
-    """A (logical) copy of the array (reference: memory.py:13). jax arrays are
-    immutable, so a metadata-fresh wrapper suffices."""
+    """A copy of the array (reference: memory.py:13).
+
+    jax arrays are immutable, but a metadata-fresh wrapper is NOT enough:
+    a later destructive ``resplit_`` of the original would DONATE the
+    shared buffer to XLA and invalidate the "copy".  So the PHYSICAL
+    array (pad kept — the split metadata stays truthful) goes through a
+    jitted identity, which without donation is guaranteed to produce a
+    genuinely new buffer, and the result keeps the source's sharding
+    (``jnp.copy`` alone gathers a NamedSharding array to one device)."""
     if not isinstance(x, DNDarray):
         raise TypeError(f"input needs to be a DNDarray, got {type(x)}")
-    import jax.numpy as jnp
+    global _JIT_COPY
+    if _JIT_COPY is None:
+        import jax
+        import jax.numpy as jnp
 
-    return DNDarray(
-        jnp.copy(x.larray), x.shape, x.dtype, x.split, x.device, x.comm
-    )
+        _JIT_COPY = jax.jit(jnp.copy)
+    phys = x.parray
+    out = _JIT_COPY(phys)
+    if getattr(out, "sharding", None) != getattr(phys, "sharding", None):
+        import jax
+
+        out = jax.device_put(out, phys.sharding)
+    return DNDarray(out, x.shape, x.dtype, x.split, x.device, x.comm)
 
 
 def sanitize_memory_layout(x, order: str = "C"):

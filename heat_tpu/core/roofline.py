@@ -161,6 +161,13 @@ def attribute(entry: dict, peaks: Optional[dict] = None) -> Optional[dict]:
             verdict = "unknown-peak"  # no predicted work on either axis
         else:
             verdict = "memory-bound" if t_hbm >= t_compute else "compute-bound"
+    # the memory axis (memtrack watermarks folded in by timed_call):
+    # measured peak residency vs the cost model's predicted mandatory
+    # traffic — the honest sequel to predicted-vs-measured time.  An
+    # amplification >> 1 means the program's working set dwarfs its
+    # operands (staging copies, retained intermediates, mirror buffers).
+    peak_bytes = entry.get("peak_bytes")
+    amp = round(peak_bytes / hbm, 3) if peak_bytes and hbm else None
     return {
         "fingerprint": entry["fingerprint"],
         "kind": entry.get("kind"),
@@ -174,6 +181,9 @@ def attribute(entry: dict, peaks: Optional[dict] = None) -> Optional[dict]:
         "achieved_gbps": round(gbps, 3),
         "frac_compute_roofline": round(frac_c, 4) if frac_c is not None else None,
         "frac_hbm_roofline": round(frac_h, 4) if frac_h is not None else None,
+        "peak_bytes": peak_bytes,
+        "mem_amplification": amp,
+        "mem_source": entry.get("mem_source"),
         "verdict": verdict,
         "mesh": entry.get("mesh"),
     }
@@ -222,16 +232,18 @@ def render(doc: Optional[dict] = None, top: Optional[int] = None) -> str:
     lines.append(
         f"{'fingerprint':<14}{'kind':<20}{'calls':>6}{'total_s':>10}"
         f"{'p50_s':>10}{'GFLOP/s':>10}{'GB/s':>9}{'%comp':>7}{'%hbm':>7}"
-        "  verdict"
+        f"{'peakMB':>8}{'amp':>6}  verdict"
     )
     for r in doc["rows"]:
         pc = f"{100 * r['frac_compute_roofline']:.1f}" if r["frac_compute_roofline"] is not None else "-"
         ph = f"{100 * r['frac_hbm_roofline']:.1f}" if r["frac_hbm_roofline"] is not None else "-"
+        pk = f"{r['peak_bytes'] / 1e6:.1f}" if r.get("peak_bytes") else "-"
+        am = f"{r['mem_amplification']:.2f}" if r.get("mem_amplification") else "-"
         lines.append(
             f"{r['fingerprint']:<14}{(r['kind'] or ''):<20}{r['calls']:>6}"
             f"{r['total_s']:>10.4f}{r['p50_s']:>10.6f}"
             f"{r['achieved_gflops']:>10.2f}{r['achieved_gbps']:>9.2f}"
-            f"{pc:>7}{ph:>7}  {r['verdict']}"
+            f"{pc:>7}{ph:>7}{pk:>8}{am:>6}  {r['verdict']}"
         )
     if doc["memory_bound_tail"]:
         lines.append(

@@ -88,12 +88,16 @@ __all__ = [
     "clear_events",
     "export_prometheus",
     "export_trace",
+    "leaks",
     "level",
+    "live_buffers",
+    "memwatch",
     "open_spans",
     "postmortem",
     "program_hit",
     "programs",
     "record_event",
+    "record_peak",
     "record_program",
     "record_timing",
     "register_group",
@@ -334,14 +338,46 @@ def _program_prom_lines(lines: List[str]) -> None:
             lines.extend(samples)
 
 
+def _mem_prom_lines(lines: List[str]) -> None:
+    """``heat_tpu_mem_*`` gauges from the residency ledger: live bytes,
+    live buffer count, the ledger high-water mark, and per-device sampled
+    peaks (labeled by device)."""
+    try:
+        from . import memtrack
+
+        s = memtrack.summary()
+        peaks = memtrack.device_peaks()
+    except Exception:  # the ledger must never break a metrics scrape
+        return
+    for name, val, help_ in (
+        ("heat_tpu_mem_live_bytes", s["live_bytes"],
+         "bytes held by ledgered live buffers"),
+        ("heat_tpu_mem_live_buffers", s["live_buffers"],
+         "count of ledgered live buffers"),
+        ("heat_tpu_mem_peak_live_bytes", s["peak_live_bytes"],
+         "high-water mark of ledgered live bytes"),
+    ):
+        lines.append(f"# HELP {name} heat_tpu telemetry gauge {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {val}")
+    if peaks:
+        name = "heat_tpu_mem_device_peak_bytes"
+        lines.append(f"# HELP {name} heat_tpu telemetry gauge max sampled "
+                     f"bytes_in_use per device")
+        lines.append(f"# TYPE {name} gauge")
+        for dev, val in peaks.items():
+            lines.append(f'{name}{{device="{_label_escape(dev)}"}} {val}')
+
+
 def export_prometheus() -> str:
     """Text exposition format (``# HELP`` + ``# TYPE gauge`` + one value
     line per numeric leaf): every registered group flattened as
     ``heat_tpu_<group>_<counter>`` (label-unsafe characters in group and
     counter names escaped to ``_``; the ``# HELP`` line keeps the
     original dotted path), plus labeled per-program
-    ``heat_tpu_program_*`` gauges for the measured roofline rows.
-    Non-numeric fields are skipped."""
+    ``heat_tpu_program_*`` gauges for the measured roofline rows and the
+    ``heat_tpu_mem_*`` residency gauges.  Non-numeric fields are
+    skipped."""
     lines: List[str] = []
     for name in _GROUPS:
         _prom_lines(
@@ -349,6 +385,7 @@ def export_prometheus() -> str:
             lines, src=name,
         )
     _program_prom_lines(lines)
+    _mem_prom_lines(lines)
     return "\n".join(lines) + "\n"
 
 
@@ -443,6 +480,14 @@ def dump(file=None) -> None:
         "programs": programs(),
         "counters": snapshot(),
     }
+    try:
+        from . import memtrack
+
+        # who held HBM at dump time: the OOM-forensics census (top-K live
+        # buffers with creation sites), riding every postmortem document
+        doc["buffers"] = memtrack.census(top=16)
+    except Exception:
+        doc["buffers"] = None
     if isinstance(file, (str, os.PathLike)):
         with open(file, "w") as fh:
             json.dump(doc, fh, indent=1, default=repr)
@@ -775,26 +820,61 @@ def record_timing(fp: Optional[str], dur_s: float) -> None:
     t["samples"].append(dur_s)
 
 
+def record_peak(fp: Optional[str], peak_bytes, source: Optional[str] = None) -> None:
+    """Fold one memory watermark reading into a program's measured view
+    (max over samples).  ``source`` says how the number was read:
+    ``device`` (a real ``memory_stats()['bytes_in_use']``) or ``ledger``
+    (memtrack's tracked live bytes — the stats-less-backend fallback)."""
+    if fp is None or peak_bytes is None or _LEVEL < _COUNTERS:
+        return
+    t = _TIMINGS.get(fp)
+    if t is None:
+        t = _TIMINGS[fp] = {
+            "calls": 0,
+            "total_s": 0.0,
+            "min_s": float("inf"),
+            "samples": deque(maxlen=_TIMING_SAMPLES),
+        }
+    if int(peak_bytes) > t.get("peak_bytes", -1):
+        t["peak_bytes"] = int(peak_bytes)
+        t["mem_source"] = source
+
+
 def _timing_view(fp: str) -> dict:
     t = _TIMINGS.get(fp)
-    if t is None or not t["calls"]:
+    if t is None:
         return {}
-    ordered = sorted(t["samples"])
-    return {
-        "calls": t["calls"],
-        "total_s": round(t["total_s"], 6),
-        "min_s": round(t["min_s"], 6),
-        "p50_s": round(ordered[len(ordered) // 2], 6),
-    }
+    out = {}
+    if t["calls"]:
+        ordered = sorted(t["samples"])
+        out = {
+            "calls": t["calls"],
+            "total_s": round(t["total_s"], 6),
+            "min_s": round(t["min_s"], 6),
+            "p50_s": round(ordered[len(ordered) // 2], 6),
+        }
+    if "peak_bytes" in t:
+        out["peak_bytes"] = t["peak_bytes"]
+        out["mem_source"] = t.get("mem_source")
+    return out
 
 
 def timed_call(fp: Optional[str], fn: Callable, *args):
     """Run ``fn(*args)`` (a jitted executable); when the sampling gate
     fires, block until the outputs are ready and accumulate the wall
-    clock under ``fp``.  With ``fp=None`` or an idle gate this is a plain
-    call — async dispatch is only serialized on sampled calls."""
+    clock under ``fp``, sampling the memory watermark
+    (:func:`memtrack.sample_bytes`) on entry and exit so the program
+    gains a measured ``peak_bytes`` and the flight recorder a
+    ``mem_sample`` trail (the Perfetto counter track).  With ``fp=None``
+    or an idle gate this is a plain call — async dispatch is only
+    serialized on sampled calls."""
     if fp is None or not timing_active():
         return fn(*args)
+    from . import memtrack
+
+    b0, src0 = memtrack.sample_bytes()
+    if b0 is not None:
+        record_event("mem_sample", fingerprint=fp, bytes_in_use=b0, source=src0)
     t0 = time.perf_counter()
     out = fn(*args)
     try:
@@ -804,6 +884,11 @@ def timed_call(fp: Optional[str], fn: Callable, *args):
     except Exception:  # timing must never break the computation
         pass
     record_timing(fp, time.perf_counter() - t0)
+    b1, src1 = memtrack.sample_bytes()
+    if b1 is not None:
+        record_event("mem_sample", fingerprint=fp, bytes_in_use=b1, source=src1)
+    peak = max((b for b in (b0, b1) if b is not None), default=None)
+    record_peak(fp, peak, src1 or src0)
     return out
 
 
@@ -817,6 +902,42 @@ def roofline_report(top: Optional[int] = None, peaks: Optional[dict] = None) -> 
     from . import roofline
 
     return roofline.report(programs(), top=top, peaks=peaks)
+
+
+# ------------------------------------------------------------- memory axis
+# The residency ledger lives in core/memtrack.py (the memory counterpart
+# of roofline.py); these delegators surface its queries on the telemetry
+# façade so callers need one import for both axes.
+
+def live_buffers(top: Optional[int] = 10) -> List[dict]:
+    """The live HBM residency ledger, largest buffer first — nbytes,
+    dtype, shape, split, sharding, tag, pin state, and the user creation
+    site (see :func:`heat_tpu.core.memtrack.live_buffers`)."""
+    from . import memtrack
+
+    return memtrack.live_buffers(top=top)
+
+
+def leaks() -> List[dict]:
+    """Suspected retained memory: orphaned fusion pins and buffers that
+    outlived a ``memwatch()`` scope (see
+    :func:`heat_tpu.core.memtrack.leaks`)."""
+    from . import memtrack
+
+    return memtrack.leaks()
+
+
+def memwatch():
+    """Retention-detection scope (see
+    :func:`heat_tpu.core.memtrack.memwatch`)::
+
+        with telemetry.memwatch() as w:
+            ...
+        assert not w.retained
+    """
+    from . import memtrack
+
+    return memtrack.memwatch()
 
 
 def reset() -> None:
@@ -888,6 +1009,12 @@ def export_trace(file=None) -> List[dict]:
             begun.pop(e["id"], None)
             out.append({"ph": "E", "ts": us(e["ts"]), "pid": pid, "tid": tid,
                         "cat": "span", "name": e["name"], "args": args})
+        elif kind == "mem_sample":
+            # counter track: Perfetto renders the "C" series as a memory
+            # timeline beside the span lanes (one track per recording lane)
+            out.append({"ph": "C", "ts": us(e["ts"]), "pid": pid, "tid": tid,
+                        "cat": "memory", "name": "memory",
+                        "args": {"bytes_in_use": e.get("bytes_in_use", 0)}})
         else:
             out.append({"ph": "i", "s": "t", "ts": us(e["ts"]), "pid": pid,
                         "tid": tid, "cat": "event", "name": kind,

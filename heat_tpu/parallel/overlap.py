@@ -55,7 +55,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..core import telemetry
+from ..core import memtrack, telemetry
 from .collectives import (
     all_gather,
     jit_shard_map_cached,
@@ -539,6 +539,10 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     )
     a = _pad_physical(a, lshape_a, 0 if case == "ag" else 1, comm.size)
     b = _pad_physical(b, lshape_b, 1 if case == "col" else 0, comm.size)
+    # ledger the ring operands: a padded copy is transient staging; an
+    # unpadded passthrough dedupes to its existing (leaf) entry
+    memtrack.register_buffer(a, tag="staging")
+    memtrack.register_buffer(b, tag="staging")
     seen_key = (id(comm.mesh), spec)
     hit = seen_key in _SEEN
     _SEEN.add(seen_key)
@@ -559,6 +563,7 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
             out = telemetry.timed_call(ring_fp, fn, a, b, *extras)
         else:
             out = fn(a, b, *extras)
+    memtrack.register_buffer(out, tag="output", split=out_split)
     _record(
         "ring_" + case, steps=comm.size, bps=bps, out_split=out_split,
         reason=reason, cache_hit=hit,

@@ -69,7 +69,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core import guard, telemetry
+from ..core import guard, memtrack, telemetry
 from .collectives import shard_map_unchecked
 
 __all__ = [
@@ -115,6 +115,11 @@ TILE_BYTES = _env_tile_bytes()
 # be saved by smaller tiles — the local slab itself no longer fits.
 TILE_FLOOR_BYTES = 64 << 10
 
+# Fraction of measured free HBM the informed first retry claims for its
+# tile: the staging tile and its gathered mirror are both in flight during
+# an all_to_all step, plus allocator fragmentation headroom.
+_FREE_TILE_FRACTION = 0.25
+
 
 # ------------------------------------------------------------- OOM backoff
 
@@ -132,6 +137,11 @@ _STATS = telemetry.register_group(
         "last_tile_bytes": None,
         # per-kernel retry counts: {"resplit": n, "take": n, "reshape": n}
         "retries_by_kind": {},
+        # retries whose budget came from measured free HBM (memory_stats)
+        # rather than blind halving
+        "informed_retries": 0,
+        # whether the most recent retry was informed (None: no retry yet)
+        "last_retry_informed": None,
         # split-terminated lazy chains whose elementwise tail lowered INTO
         # the per-tile resplit loop (no separate pre-pass materialization)
         "fused_tails": 0,
@@ -144,9 +154,12 @@ def stats() -> dict:
     halvings that led to a retry), ``oom_exhausted`` (transfers that still
     OOMed at ``TILE_FLOOR_BYTES`` and re-raised), ``last_tile_bytes`` (the
     budget the most recent transfer succeeded at — equal to the configured
-    ``TILE_BYTES`` unless backoff engaged), ``retries_by_kind``, and
-    ``fused_tails`` (lazy-chain tails fused into the resplit tile loop —
-    each one is a materialization pre-pass that did NOT happen).
+    ``TILE_BYTES`` unless backoff engaged), ``retries_by_kind``,
+    ``informed_retries`` / ``last_retry_informed`` (first retries whose
+    budget was derived from measured free HBM instead of blind halving —
+    see ``_with_oom_backoff``), and ``fused_tails`` (lazy-chain tails
+    fused into the resplit tile loop — each one is a materialization
+    pre-pass that did NOT happen).
 
     Thin shim over ``telemetry.snapshot_group("transport")`` — the same
     counters appear in ``ht.telemetry.snapshot()``."""
@@ -184,6 +197,17 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
     includes the shard_map jit build, which the ``min_s``/``p50_s``
     robust statistics absorb.
 
+    Informed first retry: when ``memory_stats()`` is available (TPU, or a
+    test override via :func:`memtrack.stats_override` /
+    ``FaultInjector.low_hbm``), the FIRST retry sizes its budget from the
+    measured tightest free HBM instead of blind halving — capped at the
+    halved budget (never larger, so monotone progress and termination are
+    unchanged) and floored at ``TILE_FLOOR_BYTES``.  Stats-less backends
+    (CPU) keep the pure halving walk.  Every OOM also attaches a buffer
+    census (top live buffers with creation sites and pin state, plus the
+    failing tile budget) to the flight-recorder trail and — via
+    :func:`telemetry.postmortem` — the on-disk forensics dump.
+
     Donation caveat: a retry after a *failed donating execution* can find
     the input buffer already consumed by XLA; injected faults fire before
     the execution starts, and real RESOURCE_EXHAUSTED surfaces at
@@ -191,6 +215,7 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
     survives — but a mid-execution OOM on a donated transfer is not
     recoverable and will re-raise from the retry."""
     tb = TILE_BYTES if tile_bytes is None else int(tile_bytes)
+    retried = False
     with telemetry.span(f"transport.{kind}", tile_bytes=tb):
         while True:
             try:
@@ -199,21 +224,52 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
             except Exception as err:  # noqa: BLE001 — filtered to OOM below
                 if not _is_oom(err):
                     raise
+                census = (
+                    memtrack.census(top=8) if telemetry.events_enabled() else None
+                )
                 if tb <= TILE_FLOOR_BYTES:
                     _STATS["oom_exhausted"] += 1
                     telemetry.record_event(
                         "oom_exhausted", kernel=kind, tile_bytes=tb,
+                        census=census,
                     )
-                    telemetry.postmortem("transport_oom_exhausted")
+                    telemetry.postmortem(
+                        "transport_oom_exhausted", kernel=kind, tile_bytes=tb,
+                    )
                     raise
-                tb = max(TILE_FLOOR_BYTES, tb >> 1)
+                halved = max(TILE_FLOOR_BYTES, tb >> 1)
+                informed = None
+                free = None
+                if not retried:
+                    free = memtrack.min_free_bytes()
+                    if free is not None:
+                        # size the retry from measured headroom: the tile's
+                        # staging buffer and its gathered mirror are both in
+                        # flight, so claim a conservative quarter of free —
+                        # but never MORE than the halving would grant
+                        informed = max(
+                            TILE_FLOOR_BYTES,
+                            min(halved, int(free * _FREE_TILE_FRACTION)),
+                        )
+                    # a recovered OOM still leaves a forensic trail: the
+                    # first failure dumps the census-bearing document
+                    telemetry.postmortem(
+                        "transport_oom", kernel=kind, tile_bytes=tb,
+                    )
+                tb = informed if informed is not None else halved
+                retried = True
                 _STATS["oom_retries"] += 1
+                if informed is not None:
+                    _STATS["informed_retries"] += 1
+                _STATS["last_retry_informed"] = informed is not None
                 by_kind = _STATS["retries_by_kind"]
                 by_kind[kind] = by_kind.get(kind, 0) + 1
-                # the degradation trail: one event per halving, carrying
-                # the NEW (halved) budget the retry will run at
+                # the degradation trail: one event per retry, carrying the
+                # NEW budget the retry will run at and how it was chosen
                 telemetry.record_event(
                     "oom_retry", kernel=kind, tile_bytes=tb,
+                    informed=informed is not None, free_bytes=free,
+                    census=census,
                 )
                 continue
             _STATS["last_tile_bytes"] = tb

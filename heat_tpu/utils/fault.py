@@ -51,7 +51,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-from ..core import guard, telemetry
+from ..core import guard, memtrack, telemetry
 
 __all__ = [
     "ElasticFailure",
@@ -120,6 +120,9 @@ class FaultInjector:
         self._nans: Dict[int, bool] = {}
         # site -> list of pending (kind, payload) faults, consumed FIFO
         self._sites: Dict[str, List[tuple]] = {}
+        # simulated memory_stats() readings (see low_hbm): installed as
+        # memtrack's stats override alongside the guard hooks
+        self._mem_stats: Optional[List[dict]] = None
         self.fired: List[tuple] = []
 
     # ---------------------------------------------- site-level injection
@@ -151,6 +154,31 @@ class FaultInjector:
         """Sleep ``seconds`` at ``site`` — a wedged collective for
         :class:`StallDetector` to catch."""
         return self._arm(site, "stall", float(seconds), times)
+
+    def low_hbm(
+        self,
+        free_bytes: int,
+        *,
+        limit: Optional[int] = None,
+        devices: int = 1,
+    ) -> "FaultInjector":
+        """Simulate a memory-starved device: while this injector is
+        installed, :func:`memtrack.min_free_bytes` reports ``free_bytes``
+        of headroom (per device).  Pairs with :meth:`oom_in` to drive the
+        informed OOM backoff on backends with no real ``memory_stats()``
+        (CPU CI): the first retry sizes its tile from this budget instead
+        of blind halving."""
+        free = int(free_bytes)
+        lim = int(limit) if limit is not None else max(2 * free, free + 1)
+        self._mem_stats = [
+            {
+                "device": f"injected:{i}",
+                "bytes_limit": lim,
+                "bytes_in_use": lim - free,
+            }
+            for i in range(max(int(devices), 1))
+        ]
+        return self
 
     def fire_site(self, site: str) -> None:
         """Hook target for :func:`heat_tpu.core.guard.fire`."""
@@ -319,14 +347,19 @@ class _StallPause:
 
 
 def install_injector(injector: FaultInjector) -> FaultInjector:
-    """Arm the guard hooks with ``injector`` (process-wide)."""
+    """Arm the guard hooks with ``injector`` (process-wide); an injector
+    carrying :meth:`~FaultInjector.low_hbm` stats also installs them as
+    memtrack's device-stats override."""
     guard._INJECTOR = injector
+    if injector._mem_stats is not None:
+        memtrack.set_stats_override(injector._mem_stats)
     return injector
 
 
 def clear_injector() -> None:
-    """Disarm the guard hooks."""
+    """Disarm the guard hooks (and any simulated memory stats)."""
     guard._INJECTOR = None
+    memtrack.set_stats_override(None)
 
 
 @contextmanager
@@ -338,10 +371,16 @@ def injected(injector: FaultInjector):
     """
     prev = guard._INJECTOR
     guard._INJECTOR = injector
+    has_mem = injector._mem_stats is not None
+    prev_mem = (
+        memtrack.set_stats_override(injector._mem_stats) if has_mem else None
+    )
     try:
         yield injector
     finally:
         guard._INJECTOR = prev
+        if has_mem:
+            memtrack.set_stats_override(prev_mem)
 
 
 def default_health_check(metrics: Any) -> bool:

@@ -19,7 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
-from ..core import telemetry
+from ..core import memtrack, telemetry
 
 __all__ = ["monitor", "measurements", "record", "report", "reset", "profile_trace"]
 
@@ -28,21 +28,9 @@ _MEASUREMENTS: List[Dict[str, Any]] = []
 
 def _device_memory() -> Optional[int]:
     """Max bytes in use across the LOCAL devices, where the backend
-    exposes it (TPU does; CPU returns None).  The max — not device 0 —
-    is the number that matters on a multi-device mesh: uneven splits and
-    replicated operands peak on whichever device holds the remainder, and
-    reading only device 0 under-reports exactly when it hurts."""
-    worst = None
-    try:
-        for dev in jax.local_devices():
-            stats = dev.memory_stats()
-            if not stats:
-                continue
-            used = stats.get("bytes_in_use")
-            if used is not None and (worst is None or used > worst):
-                worst = used
-    except Exception:
-        return None
+    exposes it (TPU does; CPU returns None) — the unified
+    :func:`memtrack.device_bytes_in_use` reader."""
+    _per, worst = memtrack.device_bytes_in_use()
     return worst
 
 

@@ -45,6 +45,15 @@
 #                 on the checked-in BENCH_cb_r*.json trajectory, and a
 #                 cb smoke run under --check-regression proving the
 #                 delta table lands in the --out document
+#  14. memtrack  — HBM residency ledger (ISSUE 10): the memtrack test
+#                 file at meshes 8/4/1 (ledger attribution, watermark
+#                 columns, copy() layout preservation, pin lifecycle,
+#                 retention detection), then a live forensics check —
+#                 an injected RESOURCE_EXHAUSTED must leave a postmortem
+#                 census naming the user's creation site, the first
+#                 retry must size its tile budget from the measured free
+#                 HBM, and the trace export must carry a Perfetto-shaped
+#                 memory counter track
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -57,7 +66,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/13 suite (8-device mesh)"
+say "1/14 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -66,21 +75,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/13 core subset (4-device mesh)"
+say "2/14 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/13 parity audit (exits nonzero on any gap)"
+say "3/14 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/13 multi-chip dry-run"
+say "4/14 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/13 cb smoke"
+say "5/14 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -89,10 +98,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/13 copycheck"
+say "6/14 copycheck"
 python scripts/copycheck.py
 
-say "7/13 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/14 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -108,10 +117,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/13 fusion retrace guard (second call must hit the compile cache)"
+say "8/14 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/13 guardrails (fault injection + strict-guard retrace check)"
+say "9/14 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -122,7 +131,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/13 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/14 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -130,13 +139,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/13 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/14 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/13 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/14 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -161,12 +170,13 @@ for l in samples:
     assert family in helped, f"undocumented sample {family}"
     float(value)
 for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
-             "heat_tpu_overlap_calls", "heat_tpu_telemetry_events"):
+             "heat_tpu_overlap_calls", "heat_tpu_telemetry_events",
+             "heat_tpu_mem_live_bytes"):
     assert want in typed, f"missing metric family {want}"
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/13 roofline attribution + perf-regression gate"
+say "13/14 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -213,6 +223,66 @@ assert reg["rows"], "check-regression attached an empty delta table"
 assert not reg["regressions"], f"regressions on smoke run: {reg['regressions']}"
 print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
+EOF
+
+say "14/14 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+# the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
+# live end-to-end forensics check: census-bearing postmortem, informed
+# first retry from measured free HBM, and the memory counter track
+python -m pytest -q -p no:cacheprovider \
+  tests/test_memtrack.py 2>&1 | tee /tmp/ci_memtrack.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_memtrack.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_memtrack.py
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'EOF'
+import json, os
+os.environ["HEAT_TPU_TELEMETRY_DUMP"] = "/tmp/ci_oom_dump.json"
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import telemetry
+from heat_tpu.parallel import transport
+from heat_tpu.utils import fault
+
+prev = telemetry.set_level("events")
+a = ht.arange(8 * 256, dtype=ht.float32, split=0).reshape((8, 256))
+b = ht.arange(8 * 256, dtype=ht.float32, split=0).reshape((8, 256))
+expected = np.asarray(b.resplit_(1).larray)
+free = 2 << 20
+inj = (fault.FaultInjector(seed=0)
+       .oom_in("transport.resplit", times=1)
+       .low_hbm(free))
+with fault.injected(inj):
+    a.resplit_(1)
+np.testing.assert_array_equal(np.asarray(a.larray), expected)
+
+doc = json.load(open("/tmp/ci_oom_dump.json"))
+census = doc["buffers"]
+assert census["live_buffers"] > 0, "postmortem census is empty"
+sites = [r["site"] for r in census["top"]]
+assert any("<stdin>" in (s or "") for s in sites), \
+    f"census does not attribute this script's buffers: {sites}"
+
+st = transport.stats()
+assert st["oom_retries"] == 1 and st["informed_retries"] == 1, st
+want = max(transport.TILE_FLOOR_BYTES,
+           min(transport.TILE_BYTES >> 1,
+               int(free * transport._FREE_TILE_FRACTION)))
+assert st["last_tile_bytes"] == want, (st["last_tile_bytes"], want)
+
+trace = telemetry.export_trace("/tmp/ci_memtrack_trace.json")
+counters = [e for e in trace if e.get("ph") == "C"]
+assert counters, "no memory counter track in trace"
+for e in counters:
+    for key in ("ph", "ts", "pid", "tid"):
+        assert key in e, f"counter event missing {key}: {e}"
+    assert e["name"] == "memory"
+    assert isinstance(e["args"]["bytes_in_use"], int)
+telemetry.set_level(prev)
+print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
+      f"names the user site, informed retry at {st['last_tile_bytes']} "
+      f"bytes, {len(counters)} counter samples")
 EOF
 
 say "CI GREEN"

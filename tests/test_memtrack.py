@@ -1,0 +1,535 @@
+"""HBM residency ledger (ISSUE 10): buffer attribution, watermarks,
+OOM forensics, informed backoff, and retention detection.
+
+Doctrine stays "no mocks" where the production paths allow it: the OOM
+tests inject ``RESOURCE_EXHAUSTED`` through the real FaultInjector/guard
+hooks and read the forensics back out of the real postmortem dump; the
+informed-backoff tests drive the real ``memory_stats()`` consumer through
+``FaultInjector.low_hbm`` — the documented escape hatch for backends
+(CPU CI) whose devices report no stats at all.
+"""
+
+import gc
+import json
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core import fusion, memory, memtrack, telemetry
+from heat_tpu.parallel import transport
+from heat_tpu.utils import fault, monitor
+
+from .base import TestCase
+
+
+def _mesh(n):
+    from heat_tpu.parallel.mesh import local_mesh
+
+    return local_mesh(n)
+
+
+class _EventsLevel:
+    """Scoped events level + clean recorder/ledger/memtrack on both sides."""
+
+    def __init__(self, level="events"):
+        self.level = level
+
+    def __enter__(self):
+        self.prev = telemetry.set_level(self.level)
+        telemetry.clear_events()
+        telemetry.reset_programs()
+        memtrack.reset()
+        return self
+
+    def __exit__(self, *exc):
+        telemetry.set_level(self.prev)
+        telemetry.clear_events()
+        telemetry.reset_programs()
+        memtrack.reset()
+        return False
+
+
+class TestDeviceReaders(TestCase):
+    """The unified memory_stats() readers (satellite: three duplicated
+    loops → one helper, tolerant of None backends)."""
+
+    def test_tolerates_statsless_backend(self):
+        # CPU devices report no memory_stats: per-device rows say None
+        # and the max is None — never a fake zero
+        per, worst = memtrack.device_bytes_in_use()
+        self.assertEqual(len(per), len(jax.local_devices()))
+        for _name, used in per:
+            self.assertTrue(used is None or isinstance(used, int))
+        if all(u is None for _n, u in per):
+            self.assertIsNone(worst)
+        self.assertIsNone(
+            memtrack.min_free_bytes()
+            if all(u is None for _n, u in per) else None
+        )
+
+    def test_override_reports_injected_stats(self):
+        with memtrack.stats_override([
+            {"device": "fake0", "bytes_in_use": 900, "bytes_limit": 1000},
+            {"device": "fake1", "bytes_in_use": 300, "bytes_limit": 1000},
+        ]):
+            per, worst = memtrack.device_bytes_in_use()
+            self.assertEqual(worst, 900)
+            self.assertEqual([u for _n, u in per], [900, 300])
+            # tightest headroom across devices, not device 0's
+            self.assertEqual(memtrack.min_free_bytes(), 100)
+        # scoped: cleared on exit
+        _per, worst = memtrack.device_bytes_in_use()
+        if all(u is None for _n, u in _per):
+            self.assertIsNone(worst)
+
+    def test_monitor_delegates_to_unified_reader(self):
+        with memtrack.stats_override(
+            [{"device": "fake0", "bytes_in_use": 4242, "bytes_limit": 9000}]
+        ):
+            self.assertEqual(monitor._device_memory(), 4242)
+
+
+class TestLedger(TestCase):
+    """Live-buffer ledger: registration, attribution, lifetime, gating."""
+
+    def test_factory_buffer_carries_this_files_site(self):
+        with _EventsLevel():
+            x = ht.arange(1024, dtype=ht.float32, split=0)
+            rows = telemetry.live_buffers(top=None)
+            mine = [r for r in rows if "test_memtrack.py" in (r["site"] or "")]
+            self.assertTrue(mine, f"no ledger row cites this test file: {rows}")
+            row = mine[0]
+            self.assertEqual(row["nbytes"], int(x.parray.nbytes))
+            self.assertEqual(row["dtype"], "float32")
+            self.assertEqual(row["split"], 0)
+            self.assertIn("NamedSharding", row["sharding"] or "")
+            self.assertIn(row["tag"], ("leaf", "pinned"))
+
+    def test_entry_dies_with_its_buffer(self):
+        with _EventsLevel():
+            x = ht.zeros((2048,), dtype=ht.float32, split=0)
+            self.assertEqual(memtrack.summary()["live_buffers"], 1)
+            before = memtrack.summary()["live_bytes"]
+            self.assertGreater(before, 0)
+            del x
+            gc.collect()
+            s = memtrack.summary()
+            self.assertEqual(s["live_buffers"], 0)
+            self.assertEqual(s["live_bytes"], 0)
+            # the high-water mark survives the release
+            self.assertEqual(s["peak_live_bytes"], before)
+
+    def test_rewrap_of_live_buffer_is_a_rebind_not_a_new_entry(self):
+        with _EventsLevel():
+            x = ht.ones((512,), dtype=ht.float32, split=0)
+            snap0 = telemetry.snapshot_group("memtrack")
+            _alias = ht.DNDarray(
+                x.parray, x.shape, x.dtype, x.split, x.device, x.comm
+            )
+            snap1 = telemetry.snapshot_group("memtrack")
+            self.assertEqual(snap1["live_buffers"], snap0["live_buffers"])
+            self.assertEqual(snap1["rebinds"], snap0["rebinds"] + 1)
+
+    def test_off_level_registers_nothing(self):
+        prev = telemetry.set_level("off")
+        try:
+            memtrack.reset()
+            _x = ht.arange(256, dtype=ht.float32, split=0)
+            s = memtrack.summary()
+            self.assertEqual(s["live_buffers"], 0)
+            self.assertEqual(s["live_bytes"], 0)
+            self.assertIsNone(memtrack.register_buffer(_x.parray))
+        finally:
+            telemetry.set_level(prev)
+            memtrack.reset()
+
+    def test_kill_switch_silences_ledger_and_sampler(self):
+        # HEAT_TPU_MEMTRACK=0 below the telemetry level: the flight
+        # recorder stays live, the ledger/sampler go quiet
+        with _EventsLevel():
+            prev = memtrack.set_enabled(False)
+            try:
+                x = ht.arange(256, dtype=ht.float32, split=0)
+                self.assertIsNone(memtrack.register_buffer(x.parray))
+                self.assertEqual(memtrack.summary()["live_buffers"], 0)
+                self.assertEqual(memtrack.sample_bytes(), (None, None))
+            finally:
+                memtrack.set_enabled(prev)
+            self.assertTrue(memtrack.enabled())
+            y = ht.arange(256, dtype=ht.float32, split=0)
+            self.assertGreater(memtrack.summary()["live_buffers"], 0)
+            del x, y
+
+    def test_snapshot_carries_memtrack_group(self):
+        snap = telemetry.snapshot()
+        self.assertIn("memtrack", snap)
+        for key in ("registered", "released", "live_buffers", "live_bytes",
+                    "peak_live_bytes", "bytes_by_tag"):
+            self.assertIn(key, snap["memtrack"])
+
+    def test_donated_buffer_is_tagged(self):
+        if self.get_size() < 2:
+            self.skipTest("needs a multi-device mesh")
+        with _EventsLevel():
+            n = self.get_size()
+            data = np.arange(n * 64, dtype=np.float32).reshape((n, 64))
+            x = ht.array(data, split=0)
+            gc.collect()  # no pending chain may pin the buffer
+            buf = x.parray  # strong ref: the ledger row outlives donation
+            self.assertTrue(fusion.safe_to_donate(buf))
+            x.resplit_(1)
+            rows = telemetry.live_buffers(top=None)
+            mine = [r for r in rows if r["id"] == id(buf)]
+            self.assertTrue(mine, "donated buffer's ledger row vanished")
+            self.assertEqual(mine[0]["tag"], "donated")
+            # and the new-layout result is ledgered as an output
+            self.assertTrue(any(r["tag"] == "output" for r in rows))
+
+
+class TestPinLifecycle(TestCase):
+    """Satellite: fusion's _PINNED registry releases under GC, donation
+    safety flips back, and the leak detector stays quiet."""
+
+    def setUp(self):
+        fusion.reset_cache()
+
+    def test_pins_release_under_gc_pressure(self):
+        with _EventsLevel():
+            x = ht.arange(512, dtype=ht.float32, split=0)
+            buf = x.parray
+            self.assertTrue(fusion.safe_to_donate(buf))
+            pending = [(x + float(i)) * 2.0 for i in range(8)]
+            self.assertFalse(fusion.safe_to_donate(buf))
+            del pending
+            gc.collect()
+            self.assertTrue(fusion.safe_to_donate(buf))
+            self.assertEqual(fusion.pin_leaks(), [])
+            self.assertEqual(telemetry.leaks(), [])
+
+    def test_safe_to_donate_flips_back_after_materialize(self):
+        with _EventsLevel():
+            x = ht.arange(256, dtype=ht.float32, split=0)
+            buf = x.parray
+            y = (x + 1.0) * 2.0
+            self.assertFalse(fusion.safe_to_donate(buf))
+            _ = y.larray  # materialize: the chain no longer pends on x
+            del y
+            gc.collect()
+            self.assertTrue(fusion.safe_to_donate(buf))
+
+    def test_leaks_empty_after_full_materialize(self):
+        with _EventsLevel():
+            x = ht.arange(1024, dtype=ht.float32, split=0)
+            ys = [(x * float(i + 1)) - 0.5 for i in range(4)]
+            fusion.materialize_all(*ys)
+            del ys
+            gc.collect()
+            self.assertEqual(fusion.pin_leaks(), [])
+            self.assertEqual(telemetry.leaks(), [])
+
+
+class TestRetentionDetection(TestCase):
+    """memwatch() scopes and telemetry.leaks()."""
+
+    def test_memwatch_names_the_survivor(self):
+        with _EventsLevel():
+            keep = []
+            with telemetry.memwatch() as w:
+                scratch = ht.zeros((4096,), dtype=ht.float32, split=0)
+                keep.append(ht.ones((64,), dtype=ht.float32, split=0))
+                del scratch
+            self.assertEqual(len(w.retained), 1)
+            self.assertIn("test_memtrack.py", w.retained[0]["site"])
+            self.assertEqual(w.retained[0]["nbytes"],
+                             int(keep[0].parray.nbytes))
+            # the survivor also surfaces through leaks() while it lives...
+            kinds = [r["kind"] for r in telemetry.leaks()]
+            self.assertIn("retained", kinds)
+            keep.clear()
+            gc.collect()
+            # ...and drops out once it actually dies
+            self.assertEqual(
+                [r for r in telemetry.leaks() if r["kind"] == "retained"], []
+            )
+
+    def test_memwatch_clean_scope_is_empty(self):
+        with _EventsLevel():
+            with telemetry.memwatch() as w:
+                scratch = ht.zeros((4096,), dtype=ht.float32, split=0)
+                _ = float(scratch.larray[0])
+                del scratch
+            self.assertEqual(w.retained, [])
+
+
+class TestWatermarks(TestCase):
+    """Peak-memory attribution via timed_call sampling: programs() rows,
+    roofline columns, and the Perfetto counter track."""
+
+    def _fused_chain(self):
+        # force a compile miss so the chain re-records into the (reset)
+        # program ledger; the hits that follow are the timed+sampled calls
+        fusion.reset_cache()
+        x = ht.arange(2048, dtype=ht.float32, split=0)
+        for _ in range(3):  # call 2+ is a cache hit → timed + sampled
+            _ = float(((x + 1.0) * 2.0 - 0.5).larray[0])
+        return x
+
+    def test_programs_gain_peak_bytes(self):
+        with _EventsLevel():
+            _x = self._fused_chain()
+            withpeak = [p for p in telemetry.programs() if "peak_bytes" in p]
+            self.assertTrue(withpeak, "no program carries peak_bytes")
+            p = withpeak[0]
+            self.assertGreater(p["peak_bytes"], 0)
+            # CPU devices expose no stats: the honest source is the ledger
+            self.assertIn(p["mem_source"], ("device", "ledger"))
+
+    def test_roofline_rows_carry_memory_columns(self):
+        with _EventsLevel():
+            _x = self._fused_chain()
+            rows = telemetry.roofline_report()["rows"]
+            fused = [r for r in rows if r["kind"] == "fused"]
+            self.assertTrue(fused)
+            self.assertIn("peak_bytes", fused[0])
+            self.assertIn("mem_amplification", fused[0])
+            self.assertIn("mem_source", fused[0])
+            got = [r for r in rows if r.get("peak_bytes")]
+            self.assertTrue(got, "no roofline row measured a peak")
+            for r in got:
+                if r["mem_amplification"] is not None:
+                    self.assertAlmostEqual(
+                        r["mem_amplification"],
+                        round(r["peak_bytes"] / r["hbm_bytes"], 3),
+                    )
+
+    def test_transport_rows_carry_peaks(self):
+        if self.get_size() < 2:
+            self.skipTest("needs a multi-device mesh")
+        with _EventsLevel():
+            x = ht.arange(8 * 128, dtype=ht.float32, split=0).reshape((8, 128))
+            x.resplit_(1)
+            rows = telemetry.roofline_report()["rows"]
+            tr = [r for r in rows if (r["kind"] or "").startswith("transport")]
+            self.assertTrue(tr)
+            self.assertTrue(any(r.get("peak_bytes") for r in tr))
+
+    def test_export_trace_emits_counter_track(self):
+        with _EventsLevel():
+            _x = self._fused_chain()
+            trace = telemetry.export_trace()
+            counters = [e for e in trace if e["ph"] == "C"]
+            self.assertTrue(counters, "no memory counter track in trace")
+            for e in counters:
+                for key in ("ph", "ts", "pid", "tid"):  # Perfetto shape
+                    self.assertIn(key, e)
+                self.assertEqual(e["name"], "memory")
+                self.assertIsInstance(e["args"]["bytes_in_use"], int)
+            # the series is non-trivial: at least one positive reading
+            self.assertTrue(
+                any(e["args"]["bytes_in_use"] > 0 for e in counters)
+            )
+
+    def test_device_override_becomes_the_sample_source(self):
+        with _EventsLevel():
+            with memtrack.stats_override(
+                [{"device": "fake0", "bytes_in_use": 7777, "bytes_limit": 9999}]
+            ):
+                got, src = memtrack.sample_bytes()
+            self.assertEqual((got, src), (7777, "device"))
+            self.assertEqual(memtrack.device_peaks().get("fake0"), 7777)
+
+
+class TestOOMForensics(TestCase):
+    """Injected RESOURCE_EXHAUSTED: census-bearing postmortem + informed
+    first retry from measured free HBM."""
+
+    def setUp(self):
+        if self.get_size() < 2:
+            self.skipTest("resplit tile loop needs a multi-device mesh")
+        transport.reset_stats()
+
+    def tearDown(self):
+        transport.reset_stats()
+
+    def _operand(self):
+        n = self.get_size()
+        return ht.arange(n * 256, dtype=ht.float32, split=0).reshape((n, 256))
+
+    def test_census_names_this_test_file(self):
+        with _EventsLevel():
+            a = self._operand()
+            expected = np.asarray(self._operand().resplit_(1).larray)
+            with tempfile.TemporaryDirectory() as td:
+                path = os.path.join(td, "oom_dump.json")
+                os.environ["HEAT_TPU_TELEMETRY_DUMP"] = path
+                try:
+                    inj = fault.FaultInjector(seed=0).oom_in(
+                        "transport.resplit", times=1
+                    )
+                    with fault.injected(inj):
+                        a.resplit_(1)
+                finally:
+                    del os.environ["HEAT_TPU_TELEMETRY_DUMP"]
+                self.assertTrue(os.path.exists(path), "no postmortem dump")
+                doc = json.load(open(path))
+                census = doc["buffers"]
+                self.assertGreater(census["live_buffers"], 0)
+                sites = [r["site"] for r in census["top"]]
+                self.assertTrue(
+                    any("test_memtrack.py" in (s or "") for s in sites),
+                    f"census names no buffer from this file: {sites}",
+                )
+            # the trail carries the census too, with the failing budget
+            trail = telemetry.events("oom_retry")
+            self.assertTrue(trail)
+            self.assertIsNotNone(trail[-1]["census"])
+            # and the recovered transfer still equals the no-fault run
+            np.testing.assert_array_equal(np.asarray(a.larray), expected)
+
+    def test_first_retry_is_informed_by_measured_free_hbm(self):
+        with _EventsLevel():
+            a = self._operand()
+            expected = np.asarray(self._operand().resplit_(1).larray)
+            free = 2 << 20
+            inj = (
+                fault.FaultInjector(seed=0)
+                .oom_in("transport.resplit", times=1)
+                .low_hbm(free)
+            )
+            with fault.injected(inj):
+                a.resplit_(1)
+            st = transport.stats()
+            self.assertEqual(st["oom_retries"], 1)
+            self.assertEqual(st["informed_retries"], 1)
+            self.assertTrue(st["last_retry_informed"])
+            want = max(
+                transport.TILE_FLOOR_BYTES,
+                min(transport.TILE_BYTES >> 1,
+                    int(free * transport._FREE_TILE_FRACTION)),
+            )
+            self.assertEqual(st["last_tile_bytes"], want)
+            evt = telemetry.events("oom_retry")[-1]
+            self.assertTrue(evt["informed"])
+            self.assertEqual(evt["free_bytes"], free)
+            self.assertEqual(evt["tile_bytes"], want)
+            np.testing.assert_array_equal(np.asarray(a.larray), expected)
+
+    def test_informed_budget_never_exceeds_halving(self):
+        with _EventsLevel():
+            a = self._operand()
+            # lavish free memory: the informed path must cap at the halved
+            # budget (monotone progress), not balloon past it
+            inj = (
+                fault.FaultInjector(seed=0)
+                .oom_in("transport.resplit", times=1)
+                .low_hbm(64 << 30)
+            )
+            with fault.injected(inj):
+                a.resplit_(1)
+            st = transport.stats()
+            self.assertEqual(st["last_tile_bytes"], transport.TILE_BYTES >> 1)
+            self.assertTrue(st["last_retry_informed"])
+
+    def test_statsless_backend_keeps_blind_halving(self):
+        with _EventsLevel():
+            a = self._operand()
+            inj = fault.FaultInjector(seed=0).oom_in(
+                "transport.resplit", times=2
+            )
+            with fault.injected(inj):
+                a.resplit_(1)
+            st = transport.stats()
+            self.assertEqual(st["informed_retries"], 0)
+            self.assertFalse(st["last_retry_informed"])
+            self.assertEqual(st["last_tile_bytes"], transport.TILE_BYTES >> 2)
+
+
+class TestCopyFix(TestCase):
+    """Satellite: copy() must produce an independent, sharding-preserving
+    physical buffer at every mesh size."""
+
+    def _check(self, comm):
+        n = 4 * comm.size + 3  # odd → pad on the split axis where size>1
+        data = np.arange(n * 6, dtype=np.float32).reshape((n, 6))
+        x = ht.array(data, split=0, comm=comm)
+        c = memory.copy(x)
+        # metadata + value equality
+        self.assertEqual(c.split, x.split)
+        self.assertEqual(tuple(c.shape), tuple(x.shape))
+        np.testing.assert_array_equal(np.asarray(c.larray), data)
+        # the copy keeps the source's PHYSICAL layout: same sharding,
+        # same (possibly padded) physical shape — the old bug stored an
+        # unpadded, gathered buffer under split metadata that says padded
+        self.assertEqual(c.parray.sharding, x.parray.sharding)
+        self.assertEqual(tuple(c.parray.shape), tuple(x.parray.shape))
+        # and a genuinely new buffer: destroying the original via a
+        # donating resplit must not invalidate the copy
+        if comm.size > 1:
+            x.resplit_(1)
+            np.testing.assert_array_equal(np.asarray(c.larray), data)
+
+    def test_copy_at_mesh_1(self):
+        self._check(_mesh(1))
+
+    def test_copy_at_mesh_4(self):
+        if len(jax.devices()) < 4:
+            self.skipTest("needs >= 4 devices")
+        self._check(_mesh(4))
+
+    def test_copy_at_mesh_8(self):
+        if len(jax.devices()) < 8:
+            self.skipTest("needs >= 8 devices")
+        self._check(_mesh(8))
+
+    def test_method_binding(self):
+        x = ht.arange(32, dtype=ht.float32, split=0)
+        c = x.copy()
+        np.testing.assert_array_equal(
+            np.asarray(c.larray), np.asarray(x.larray)
+        )
+
+
+class TestPrometheusGauges(TestCase):
+    """Satellite: heat_tpu_mem_* gauges with HELP/TYPE lines that satisfy
+    the stage-12 parser."""
+
+    def test_mem_families_present_and_well_formed(self):
+        with _EventsLevel():
+            _x = ht.arange(512, dtype=ht.float32, split=0)
+            with memtrack.stats_override(
+                [{"device": "fake0", "bytes_in_use": 5150, "bytes_limit": 9000}]
+            ):
+                memtrack.sample_bytes()  # fold a device peak
+                text = telemetry.export_prometheus()
+            lines = text.splitlines()
+            typed = {l.split()[2] for l in lines if l.startswith("# TYPE ")}
+            helped = {l.split()[2] for l in lines if l.startswith("# HELP ")}
+            samples = [l for l in lines if l and not l.startswith("#")]
+            for l in samples:  # the stage-12 well-formedness law
+                name, value = l.rsplit(" ", 1)
+                family = name.split("{", 1)[0]
+                self.assertIn(family, typed, f"untyped sample {family}")
+                self.assertIn(family, helped, f"undocumented sample {family}")
+                float(value)
+            for want in ("heat_tpu_mem_live_bytes",
+                         "heat_tpu_mem_live_buffers",
+                         "heat_tpu_mem_peak_live_bytes",
+                         "heat_tpu_mem_device_peak_bytes"):
+                self.assertIn(want, typed, f"missing metric family {want}")
+            live = [l for l in samples
+                    if l.startswith("heat_tpu_mem_live_bytes ")]
+            self.assertTrue(live)
+            self.assertGreater(float(live[0].rsplit(" ", 1)[1]), 0)
+            peak = [l for l in samples
+                    if l.startswith('heat_tpu_mem_device_peak_bytes{')]
+            self.assertTrue(peak)
+            self.assertIn('device="fake0"', peak[0])
+
+
+if __name__ == "__main__":
+    unittest.main()
