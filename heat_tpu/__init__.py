@@ -14,6 +14,7 @@ The user-facing namespace is flat, like the reference's
 from .core import *
 from .core import (
     arithmetics,
+    autotune,
     complex_math,
     constants,
     devices,

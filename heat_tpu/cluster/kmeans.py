@@ -378,9 +378,13 @@ def _pack_lanes(arr):
     # the unified reader reports the TIGHTEST device (None where the
     # backend has no stats — e.g. through remote TPU tunnels)
     n_dev = max(1, len(arr.devices()))
-    free = memtrack.min_free_bytes()
-    if free is not None:
-        if free < arr.size * 2 // n_dev + (1 << 30):
+    need = arr.size * 2 // n_dev
+    # THE budget formula (memtrack.suggest_budget, shared with transport's
+    # informed retry and autotune's plan-time seeding): the packed copy
+    # must fit free HBM minus a 1 GiB working-set reservation
+    granted = memtrack.suggest_budget(need, fraction=1.0, headroom=1 << 30)
+    if granted is not None:
+        if granted < need:
             return None
     elif dev.platform == "tpu":
         # no stats: estimate — lane-padded source (n*128*2B) + packed copy

@@ -38,6 +38,7 @@ from . import factories
 from .factories import *
 from . import _operations
 from . import telemetry
+from . import autotune
 from . import fusion
 from .fusion import materialize, materialize_all
 from . import sanitation

@@ -1,0 +1,608 @@
+"""Self-tuning runtime: measured explore/exploit dispatch, HBM-seeded
+budgets, and a persisted warm-start cache (ROADMAP item 2 — close the
+measure→decide loop).
+
+Rounds 11–13 built a measurement plane (per-fingerprint wall clock,
+roofline placement, real HBM watermarks); every performance decision
+still read a static env-var knob.  This module spends those
+measurements at the three engine sites:
+
+1. **Explore/exploit matmul dispatch.**  Per (program fingerprint,
+   device kind), the first K calls (``HEAT_TPU_AUTOTUNE_EXPLORE``,
+   default 3 per arm) run BOTH the ring and the GSPMD path under timed
+   measurement; the winner by steady-state ``min_s`` sticks in a
+   per-process tuning table.  The static byte threshold
+   (``HEAT_TPU_MATMUL_RING_MIN_BYTES``) is demoted to a *prior*: it
+   still decides unexplored lazy chains and breaks ties, but a measured
+   winner overrides it.  Safety margin: a sticky winner whose sampled
+   wall clock degrades >2x vs its recorded best is sent back to
+   explore.  Exploration happens at the eager engine entry
+   (``overlap.matmul_raw``); the lazy chain path only *consumes*
+   winners — it never runs both arms inside a fused program.
+
+2. **HBM-seeded budgets up front.**  ``memtrack.suggest_budget()`` (the
+   one formula behind transport's informed OOM retry) now also seeds
+   transport's tile budget and the ring matmul's staging admission at
+   plan time, instead of only shrinking after a ``RESOURCE_EXHAUSTED``.
+   Statsless backends (CPU) keep today's static defaults.
+
+3. **Persisted warm start.**  :func:`save` / :func:`load` persist the
+   tuning table as versioned JSON keyed by (fingerprint, device kind,
+   library version); ``HEAT_TPU_AUTOTUNE_CACHE`` loads it at import and
+   enables JAX's persistent compilation cache next to it, so a
+   restarted serving process replays winners with zero explore calls
+   and warm lowering.
+
+Every decision lands in the flight recorder as an ``autotune_decision``
+event (arm, times, source: explored|cached|prior) and in the
+``autotune`` counter group (Prometheus: ``heat_tpu_autotune_*``);
+:func:`report` (also ``telemetry.autotune_report()``) renders the
+table.  ``HEAT_TPU_AUTOTUNE=off`` restores the static dispatch
+bit-for-bit.  This module deliberately imports only telemetry/memtrack
+(never parallel/fusion): the engines register its :func:`salt` into the
+fusion compile-cache key via ``fusion.register_cache_salt`` so tuned
+flips build distinct entries without an import cycle.
+"""
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+from . import memtrack, telemetry
+from .version import __version__
+
+__all__ = [
+    "ARMS",
+    "CACHE_VERSION",
+    "Decision",
+    "decide",
+    "device_kind",
+    "enabled",
+    "env_bytes",
+    "explore_k",
+    "load",
+    "matmul_key",
+    "note_budget_seed",
+    "note_prior",
+    "observe",
+    "report",
+    "reset",
+    "salt",
+    "save",
+    "set_enabled",
+    "stats",
+    "table",
+    "winner",
+]
+
+ARMS = ("ring", "gspmd")
+CACHE_VERSION = 1
+
+# samples kept per arm (min_s over a bounded window; enough for the
+# explore phase plus degradation evidence, bounded so a long-lived
+# serving process never grows the table entries)
+_MAX_SAMPLES = 16
+
+# a sticky winner this many times slower than its recorded best, on
+# this many CONSECUTIVE sampled calls, goes back to explore (two
+# strikes: one slow sample is GC / scheduler noise, two is a regime
+# change — input residency, a neighbor hogging ICI, thermal throttle)
+_DEGRADE_FACTOR = 2.0
+_DEGRADE_STRIKES = 2
+
+
+# --------------------------------------------------------------- env parsing
+
+
+def env_bytes(name: str, default: int, env: Optional[dict] = None) -> int:
+    """THE byte-sized env knob parser (``HEAT_TPU_TILE_BYTES``,
+    ``HEAT_TPU_MATMUL_RING_MIN_BYTES``): empty/unset returns
+    ``default``; a malformed or non-positive value raises ``ValueError``
+    naming the variable — silently falling back to a default turns an
+    operator's typo'd budget into an invisible perf bug."""
+    raw = (os.environ if env is None else env).get(name, "").strip()
+    if not raw:
+        return int(default)
+    try:
+        val = int(raw)
+        if val <= 0:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a positive integer (bytes), got {raw!r}"
+        ) from None
+    return val
+
+
+def explore_k() -> int:
+    """Explore budget: measured samples per arm before a winner is
+    declared (``HEAT_TPU_AUTOTUNE_EXPLORE``, default 3)."""
+    raw = os.environ.get("HEAT_TPU_AUTOTUNE_EXPLORE", "").strip()
+    if not raw:
+        return 3
+    try:
+        k = int(raw)
+        if k <= 0:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            "HEAT_TPU_AUTOTUNE_EXPLORE must be a positive integer, "
+            f"got {raw!r}"
+        ) from None
+    return k
+
+
+# ------------------------------------------------------------------ enabling
+
+# None → follow the env var; a bool → API override (tests, notebooks)
+_ENABLED_OVERRIDE: "list[Optional[bool]]" = [None]
+
+
+def enabled() -> bool:
+    """Whether the tuning plane is live (``HEAT_TPU_AUTOTUNE``, default
+    **on**).  Off restores the static env-knob dispatch exactly: no
+    exploration, no table lookups, no plan-time budget seeding."""
+    if _ENABLED_OVERRIDE[0] is not None:
+        return _ENABLED_OVERRIDE[0]
+    return os.environ.get("HEAT_TPU_AUTOTUNE", "on").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+def set_enabled(on: Optional[bool]) -> Optional[bool]:
+    """Override the env toggle (``None`` restores env control).  Returns
+    the previous override.  Bumps the generation so fused programs built
+    under the other mode don't serve stale dispatch decisions."""
+    prev = _ENABLED_OVERRIDE[0]
+    _ENABLED_OVERRIDE[0] = None if on is None else bool(on)
+    if prev is not _ENABLED_OVERRIDE[0]:
+        _GENERATION[0] += 1
+    return prev
+
+
+# ------------------------------------------------------------- tuning table
+
+# (fingerprint, device_kind) → entry dict:
+#   {"arms": {"ring": [durs], "gspmd": [durs]}, "winner": None|arm,
+#    "best_s": float|None, "strikes": int, "loaded": bool, "desc": str}
+_TABLE: Dict[Tuple[str, str], dict] = {}
+
+# bumped whenever a decision could flip (winner resolved, re-explore,
+# cache load, enable toggle, reset); joins the fusion compile-cache key
+# via fusion.register_cache_salt so tuned flips build distinct entries
+_GENERATION = [0]
+
+_STATS = telemetry.register_group(
+    "autotune",
+    {
+        "decisions": 0,      # every consult that returned an arm
+        "explores": 0,       # calls that ran BOTH arms under measurement
+        "cache_hits": 0,     # decisions served by a resolved winner
+        "cache_loads": 0,    # entries restored by load()
+        "priors": 0,         # decisions that fell back to the static prior
+        "budget_seeds": 0,   # plan-time budgets shrunk from measured HBM
+        "staging_declines": 0,  # ring staging refused by the HBM budget
+        "re_explores": 0,    # winners sent back to explore on degradation
+        "fallbacks": 0,      # corrupt/stale cache files ignored
+        "saves": 0,
+    },
+    extra=lambda: {
+        "enabled": enabled(),
+        "table_size": len(_TABLE),
+        "resolved": sum(1 for e in _TABLE.values() if e["winner"]),
+        "generation": _GENERATION[0],
+    },
+)
+
+
+def stats() -> Dict[str, Any]:
+    """Snapshot of the ``autotune`` counter group (exported to
+    Prometheus as ``heat_tpu_autotune_*`` gauges)."""
+    return telemetry.snapshot_group("autotune")
+
+
+def table() -> Dict[Tuple[str, str], dict]:
+    """Deep-ish copy of the live tuning table (for tests/debugging)."""
+    return {
+        k: {**e, "arms": {a: list(d) for a, d in e["arms"].items()}}
+        for k, e in _TABLE.items()
+    }
+
+
+def reset() -> None:
+    """Drop every tuning entry and bump the generation.  Counters are
+    telemetry-owned (``telemetry.reset_all()``); the table itself is NOT
+    cleared by a counter reset — measured winners outlive metric
+    scrapes."""
+    _TABLE.clear()
+    _GENERATION[0] += 1
+
+
+def salt() -> tuple:
+    """Dispatch-relevant state for the fusion compile-cache key: a
+    program lowered while ``(enabled, generation)`` was X must not be
+    reused once a tuned winner flips the ring/GSPMD choice."""
+    return ("autotune", enabled(), _GENERATION[0])
+
+
+def _entry(key: Tuple[str, str], desc: str = "") -> dict:
+    e = _TABLE.get(key)
+    if e is None:
+        e = _TABLE[key] = {
+            "arms": {a: [] for a in ARMS},
+            "winner": None,
+            "best_s": None,
+            "strikes": 0,
+            "loaded": False,
+            "desc": desc,
+        }
+    elif desc and not e["desc"]:
+        e["desc"] = desc
+    return e
+
+
+def table_size() -> int:
+    return len(_TABLE)
+
+
+def winner(key: Tuple[str, str]) -> Optional[str]:
+    """Resolved winner for ``key`` or ``None`` (still exploring /
+    unseen).  A hit counts as a served decision — this is the lazy-chain
+    consult path."""
+    e = _TABLE.get(key)
+    if e is None or e["winner"] is None:
+        return None
+    _STATS["decisions"] += 1
+    _STATS["cache_hits"] += 1
+    telemetry.record_event(
+        "autotune_decision",
+        fingerprint=key[0], device_kind=key[1], arm=e["winner"],
+        source="cached", site="chain", times=_arm_times(e),
+    )
+    return e["winner"]
+
+
+def _arm_times(e: dict) -> Dict[str, Optional[float]]:
+    out: Dict[str, Optional[float]] = {}
+    for a in ARMS:
+        d = e["arms"][a]
+        out[a + "_min_s"] = round(min(d), 6) if d else None
+    return out
+
+
+# ------------------------------------------------------------------ devices
+
+_DEVICE_KIND: "list[Optional[str]]" = [None]
+
+
+def device_kind() -> str:
+    """``platform:kind`` of device 0 (e.g. ``tpu:TPU v4``,
+    ``cpu:TFRT_CPU``) — tuning tables must never cross accelerator
+    generations.  Cached; falls back to ``unknown`` before a backend
+    initializes (never raises)."""
+    if _DEVICE_KIND[0] is None:
+        try:
+            import jax
+
+            d = jax.devices()[0]
+            _DEVICE_KIND[0] = f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+        except Exception:
+            return "unknown"
+    return _DEVICE_KIND[0]
+
+
+def matmul_key(
+    case: str, out_split, m: int, k: int, n: int, size: int, comp: str,
+) -> Tuple[str, str]:
+    """Tuning-table key for one sharded GEMM geometry.  Deliberately
+    excludes epilogue steps: the ring-vs-GSPMD verdict is a function of
+    shape/sharding/dtype/mesh, and sharing the entry across epilogues is
+    what lets an eager explore warm the lazy chain's consult."""
+    fp = telemetry.fingerprint(
+        ("matmul", case, out_split, m, k, n, size, comp)
+    )
+    return fp, device_kind()
+
+
+# ---------------------------------------------------------------- decisions
+
+
+class Decision(NamedTuple):
+    arm: str          # "ring" | "gspmd" — what to run (explore: run both,
+    #                   return this arm's result)
+    source: str       # "explored" | "cached" | "prior"
+    explore: bool     # run BOTH arms under measurement this call
+    key: Tuple[str, str]
+
+
+def decide(key: Tuple[str, str], prior_arm: str, desc: str = "") -> Decision:
+    """One dispatch consult at the eager engine entry.  While either arm
+    has fewer than :func:`explore_k` samples the call explores (runs
+    both arms); a resolved entry serves its winner; the caller's static
+    threshold verdict rides along as the prior."""
+    e = _entry(key, desc)
+    if e["winner"] is not None:
+        _STATS["decisions"] += 1
+        _STATS["cache_hits"] += 1
+        telemetry.record_event(
+            "autotune_decision",
+            fingerprint=key[0], device_kind=key[1], arm=e["winner"],
+            source="cached", loaded=e["loaded"], times=_arm_times(e),
+        )
+        return Decision(e["winner"], "cached", False, key)
+    _STATS["decisions"] += 1
+    _STATS["explores"] += 1
+    telemetry.record_event(
+        "autotune_decision",
+        fingerprint=key[0], device_kind=key[1], arm=prior_arm,
+        source="explored", explore=True,
+        ring_samples=len(e["arms"]["ring"]),
+        gspmd_samples=len(e["arms"]["gspmd"]),
+    )
+    return Decision(prior_arm, "explored", True, key)
+
+
+def note_prior(key: Tuple[str, str], arm: str, site: str = "chain") -> None:
+    """Record that a site fell back to the static threshold (no winner
+    yet and the site cannot explore — e.g. inside a fused chain)."""
+    _STATS["decisions"] += 1
+    _STATS["priors"] += 1
+    telemetry.record_event(
+        "autotune_decision",
+        fingerprint=key[0], device_kind=key[1], arm=arm,
+        source="prior", site=site,
+    )
+
+
+def observe(key: Tuple[str, str], arm: str, dur_s: float) -> None:
+    """Fold one measured wall clock into ``key``'s arm.  Resolves the
+    winner once both arms carry :func:`explore_k` samples (argmin over
+    per-arm ``min_s`` — min, not mean: the steady state, compile and
+    cache-warm outliers washed out).  On a resolved entry this is the
+    degradation watch: ``_DEGRADE_STRIKES`` consecutive samples slower
+    than ``_DEGRADE_FACTOR``× the recorded best send it back to
+    explore."""
+    e = _TABLE.get(key)
+    if e is None:
+        e = _entry(key)
+    if e["winner"] is not None:
+        if arm != e["winner"] or not e["best_s"]:
+            return
+        if dur_s > _DEGRADE_FACTOR * e["best_s"]:
+            e["strikes"] += 1
+            if e["strikes"] >= _DEGRADE_STRIKES:
+                _STATS["re_explores"] += 1
+                telemetry.record_event(
+                    "autotune_reexplore",
+                    fingerprint=key[0], device_kind=key[1],
+                    arm=arm, observed_s=round(dur_s, 6),
+                    best_s=round(e["best_s"], 6),
+                )
+                e["arms"] = {a: [] for a in ARMS}
+                e["winner"] = None
+                e["best_s"] = None
+                e["strikes"] = 0
+                e["loaded"] = False
+                _GENERATION[0] += 1
+        else:
+            e["strikes"] = 0
+        return
+    durs = e["arms"].setdefault(arm, [])
+    durs.append(float(dur_s))
+    del durs[:-_MAX_SAMPLES]
+    k = explore_k()
+    if all(len(e["arms"][a]) >= k for a in ARMS):
+        mins = {a: min(e["arms"][a]) for a in ARMS}
+        e["winner"] = min(mins, key=mins.get)
+        e["best_s"] = mins[e["winner"]]
+        e["strikes"] = 0
+        _GENERATION[0] += 1
+        telemetry.record_event(
+            "autotune_decision",
+            fingerprint=key[0], device_kind=key[1], arm=e["winner"],
+            source="explored", resolved=True,
+            times={a + "_min_s": round(v, 6) for a, v in mins.items()},
+        )
+
+
+def timed(fn: Callable, *args) -> Tuple[Any, float]:
+    """Run ``fn(*args)`` and return ``(out, wall_s)`` with a
+    ``block_until_ready`` fence — the explore-phase measurement (always
+    fenced; the steady-state path keeps telemetry's *sampled* fence)."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:
+        pass
+    return out, time.perf_counter() - t0
+
+
+# ------------------------------------------------------------- HBM seeding
+
+
+def note_budget_seed(site: str, granted: int, default: int) -> None:
+    """Ledger one plan-time budget shrunk from measured free HBM."""
+    _STATS["budget_seeds"] += 1
+    telemetry.record_event(
+        "autotune_budget", site=site, budget=int(granted),
+        default=int(default), free_bytes=memtrack.min_free_bytes(),
+    )
+
+
+def note_staging_decline(key: Tuple[str, str], need: int, granted: int) -> None:
+    """Ledger a ring dispatch refused because staging would not fit the
+    measured free HBM (the caller falls back to GSPMD, whose
+    tile/rechunk machinery degrades gracefully under pressure)."""
+    _STATS["staging_declines"] += 1
+    telemetry.record_event(
+        "autotune_budget", site="ring_staging", fingerprint=key[0],
+        device_kind=key[1], need=int(need), budget=int(granted),
+        declined=True,
+    )
+
+
+# ---------------------------------------------------------------- warm start
+
+
+def save(path) -> int:
+    """Persist the tuning table as versioned JSON (atomic: tmp +
+    ``os.replace``).  Keyed by (fingerprint, device kind) and stamped
+    with the library version — :func:`load` refuses anything else.
+    Returns the number of entries written."""
+    entries = []
+    for (fp, dk), e in _TABLE.items():
+        entries.append({
+            "fingerprint": fp,
+            "device_kind": dk,
+            "winner": e["winner"],
+            "best_s": _finite(e["best_s"]),
+            "desc": e["desc"],
+            "arms": {a: [_finite(t) for t in d] for a, d in e["arms"].items()},
+        })
+    doc = {
+        "version": CACHE_VERSION,
+        "library": __version__,
+        "entries": entries,
+    }
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    _STATS["saves"] += 1
+    telemetry.record_event(
+        "autotune_cache", action="save", path=path, entries=len(entries),
+    )
+    return len(entries)
+
+
+def _finite(t):
+    if t is None:
+        return None
+    t = float(t)
+    return t if t < 1e9 else 1e9
+
+
+def load(path) -> int:
+    """Restore a saved tuning table.  A corrupt, stale-version, or
+    different-library file is IGNORED with a recorded ``fallback`` event
+    (a warm start must never be able to break a cold one); entries for
+    another device kind load fine — they simply never match a key here.
+    Returns the number of entries restored (0 on fallback)."""
+    path = os.fspath(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError("not a JSON object")
+        if doc.get("version") != CACHE_VERSION:
+            raise ValueError(f"cache version {doc.get('version')!r}, "
+                             f"want {CACHE_VERSION}")
+        if doc.get("library") != __version__:
+            raise ValueError(f"library {doc.get('library')!r}, "
+                             f"want {__version__!r}")
+        entries = doc["entries"]
+        parsed = []
+        for ent in entries:
+            w = ent.get("winner")
+            if w is not None and w not in ARMS:
+                raise ValueError(f"unknown arm {w!r}")
+            parsed.append((
+                (str(ent["fingerprint"]), str(ent["device_kind"])),
+                w,
+                ent.get("best_s"),
+                str(ent.get("desc") or ""),
+                {a: [float(t) for t in ent.get("arms", {}).get(a, [])]
+                 for a in ARMS},
+            ))
+    except Exception as exc:
+        _STATS["fallbacks"] += 1
+        telemetry.record_event(
+            "fallback", site="autotune.load", path=path, error=str(exc),
+        )
+        return 0
+    for key, w, best, desc, arms in parsed:
+        e = _entry(key, desc)
+        e["winner"] = w
+        e["best_s"] = float(best) if best is not None else None
+        e["arms"] = arms
+        e["strikes"] = 0
+        e["loaded"] = True
+    _STATS["cache_loads"] += len(parsed)
+    _GENERATION[0] += 1
+    telemetry.record_event(
+        "autotune_cache", action="load", path=path, entries=len(parsed),
+    )
+    return len(parsed)
+
+
+def _enable_jax_compilation_cache(path: str) -> None:
+    """Turn on JAX's persistent compilation cache next to the tuning
+    cache (same warm-restart story for LOWERED programs: the second
+    process skips XLA compilation the way it skips exploration).
+    Respects an operator's explicit setting; never raises — an old jax
+    without the knob just misses the warm lowering."""
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir:
+            return
+        jax.config.update("jax_compilation_cache_dir", path + ".jaxcache")
+        # compile walls on a warm serving path are short; cache them all
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+
+
+def _init_from_env() -> None:
+    """Import-time warm start: ``HEAT_TPU_AUTOTUNE_CACHE=<path>`` loads
+    the tuning table (a missing file is a fresh start, not a fallback)
+    and enables the JAX compilation cache at ``<path>.jaxcache``."""
+    path = os.environ.get("HEAT_TPU_AUTOTUNE_CACHE", "").strip()
+    if not path or not enabled():
+        return
+    _enable_jax_compilation_cache(path)
+    if os.path.exists(path):
+        load(path)
+
+
+# ------------------------------------------------------------------- report
+
+
+def report(top: Optional[int] = None) -> dict:
+    """The tuning table as a dashboard-ready dict: header (device kind,
+    enabled, counters) + one row per entry, resolved winners first,
+    then by fingerprint."""
+    rows = []
+    for (fp, dk), e in _TABLE.items():
+        times = _arm_times(e)
+        rows.append({
+            "fingerprint": fp,
+            "device_kind": dk,
+            "desc": e["desc"],
+            "winner": e["winner"],
+            "source": ("cached" if e["loaded"] else
+                       "explored" if e["winner"] else "prior"),
+            "best_s": _finite(e["best_s"]),
+            "ring_min_s": times["ring_min_s"],
+            "gspmd_min_s": times["gspmd_min_s"],
+            "ring_samples": len(e["arms"]["ring"]),
+            "gspmd_samples": len(e["arms"]["gspmd"]),
+        })
+    rows.sort(key=lambda r: (r["winner"] is None, r["fingerprint"]))
+    if top is not None:
+        rows = rows[:int(top)]
+    return {
+        "device_kind": device_kind(),
+        "enabled": enabled(),
+        "generation": _GENERATION[0],
+        "stats": stats(),
+        "rows": rows,
+    }
+
+
+_init_from_env()

@@ -552,6 +552,27 @@ def _terminator_salt() -> tuple:
     return tuple(s() for _, s in _TERMINATORS if s is not None)
 
 
+# zero-arg callables whose results join EVERY compile-cache key (a
+# terminator salt rides only alongside its lowerer's registration).
+# Process-wide dispatch state that changes which program a chain should
+# build — the autotune plane's (enabled, generation) — registers here,
+# so a tuned-winner flip builds a distinct cache entry instead of
+# reusing the executable lowered under the old decision.
+_CACHE_SALTS: "list[Callable]" = []
+
+
+def register_cache_salt(fn: Callable) -> Callable:
+    """Register a zero-arg callable contributing to every compile-cache
+    key (idempotent per callable)."""
+    if fn not in _CACHE_SALTS:
+        _CACHE_SALTS.append(fn)
+    return fn
+
+
+def _cache_salt() -> tuple:
+    return tuple(s() for s in _CACHE_SALTS)
+
+
 def _lower_terminated(instrs, leaves, out_slot, lshapes, gshape, split, comm,
                       target, with_guard):
     for lowerer, _ in _TERMINATORS:
@@ -991,7 +1012,7 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
         fold = n_max > _GUARD_FOLD_MIN_ELEMS
     key = (
         instrs, out_slots, lshapes, sig, gshapes, splits, targets, donate,
-        guard_on, _terminator_salt(),
+        guard_on, _terminator_salt(), _cache_salt(),
     )
     flag = None
     entry = _CACHE.get(key)

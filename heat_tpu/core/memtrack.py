@@ -75,6 +75,7 @@ __all__ = [
     "set_enabled",
     "set_stats_override",
     "stats_override",
+    "suggest_budget",
     "summary",
     "tag_buffer",
 ]
@@ -399,6 +400,39 @@ def min_free_bytes() -> Optional[int]:
         if tightest is None or free < tightest:
             tightest = free
     return tightest
+
+
+def suggest_budget(
+    request: int,
+    *,
+    fraction: float = 0.25,
+    floor: int = 0,
+    headroom: int = 0,
+    free: Optional[int] = None,
+) -> Optional[int]:
+    """THE free-HBM budget formula:
+    ``max(floor, min(request, (free - headroom) * fraction))``.
+
+    One helper behind every HBM-informed sizing decision — transport's
+    informed OOM retry, kmeans' lane-pack residency check, and the
+    autotune plane's plan-time tile/staging seeding — so the clamp
+    semantics can never drift between sites.  ``request`` is what the
+    caller would spend absent memory pressure; ``fraction`` reserves
+    slack for everything that isn't this buffer; ``headroom`` is an
+    absolute reservation subtracted before the fraction.  Returns
+    ``None`` when no device reports memory stats (statsless backends
+    keep their static defaults — never a fake budget).  Pass ``free``
+    to reuse a reading already taken this call."""
+    if free is None:
+        # cheap no-op on statsless backends: reuse sample_bytes' latch
+        # (set after one full silent device read; overrides beat it)
+        if _STATS_OVERRIDE is None and _STATSLESS[0]:
+            return None
+        free = min_free_bytes()
+        if free is None:
+            return None
+    granted = int((int(free) - int(headroom)) * float(fraction))
+    return max(int(floor), min(int(request), granted))
 
 
 def device_peaks() -> Dict[str, int]:

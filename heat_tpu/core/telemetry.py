@@ -81,6 +81,7 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
+    "autotune_report",
     "current_span",
     "dump",
     "ensure_program",
@@ -859,7 +860,7 @@ def _timing_view(fp: str) -> dict:
     return out
 
 
-def timed_call(fp: Optional[str], fn: Callable, *args):
+def timed_call(fp: Optional[str], fn: Callable, *args, observer=None):
     """Run ``fn(*args)`` (a jitted executable); when the sampling gate
     fires, block until the outputs are ready and accumulate the wall
     clock under ``fp``, sampling the memory watermark
@@ -867,7 +868,10 @@ def timed_call(fp: Optional[str], fn: Callable, *args):
     gains a measured ``peak_bytes`` and the flight recorder a
     ``mem_sample`` trail (the Perfetto counter track).  With ``fp=None``
     or an idle gate this is a plain call — async dispatch is only
-    serialized on sampled calls."""
+    serialized on sampled calls.  ``observer`` (optional callable taking
+    the duration in seconds) also sees each SAMPLED wall clock — the
+    hook the autotune plane uses to watch a sticky winner for
+    degradation without adding its own ``block_until_ready``."""
     if fp is None or not timing_active():
         return fn(*args)
     from . import memtrack
@@ -883,7 +887,13 @@ def timed_call(fp: Optional[str], fn: Callable, *args):
         jax.block_until_ready(out)
     except Exception:  # timing must never break the computation
         pass
-    record_timing(fp, time.perf_counter() - t0)
+    dur = time.perf_counter() - t0
+    record_timing(fp, dur)
+    if observer is not None:
+        try:
+            observer(dur)
+        except Exception:  # an observer must never break the computation
+            pass
     b1, src1 = memtrack.sample_bytes()
     if b1 is not None:
         record_event("mem_sample", fingerprint=fp, bytes_in_use=b1, source=src1)
@@ -938,6 +948,18 @@ def memwatch():
     from . import memtrack
 
     return memtrack.memwatch()
+
+
+def autotune_report(top: Optional[int] = None) -> dict:
+    """The tuning plane's table, rendered for dashboards: one row per
+    (fingerprint, device kind) with per-arm steady-state times, the
+    sticky winner, and where it came from (explored / cached / prior).
+    Delegates to :func:`heat_tpu.core.autotune.report` — surfaced here
+    so the ops story (``snapshot()`` / ``roofline_report()`` /
+    ``autotune_report()``) lives behind one module."""
+    from . import autotune
+
+    return autotune.report(top=top)
 
 
 def reset() -> None:

@@ -38,15 +38,24 @@ Epilogue constants enter as runtime operands, so new values never retrace.
 
 Dispatch: ``HEAT_TPU_MATMUL=auto|gspmd|ring`` (auto picks the ring above
 ``HEAT_TPU_MATMUL_RING_MIN_BYTES`` moved per ring step, GSPMD for
-tiny/replicated operands).  Eager programs are cached via
-``jit_shard_map_cached``; lazy chains live in the fusion compile cache
-(one entry per chain × dispatch mode).  :func:`stats` reports the schedule
-decisions, steps, bytes/step and cache hits.
+tiny/replicated operands).  With the tuning plane live
+(``HEAT_TPU_AUTOTUNE=on``, the default — see ``core/autotune.py``) the
+byte threshold is only a *prior*: in ``auto`` mode the first K eager
+calls per GEMM geometry run BOTH arms under measurement (the ring
+program and the GSPMD reference einsum), the winner by steady-state
+``min_s`` sticks, and lazy chains consume resolved winners at lowering
+time.  A plan-time staging check against measured free HBM
+(``memtrack.suggest_budget``) declines the ring before it can OOM.
+Eager programs are cached via ``jit_shard_map_cached``; lazy chains live
+in the fusion compile cache (one entry per chain × dispatch mode ×
+autotune generation).  :func:`stats` reports the schedule decisions,
+steps, bytes/step and cache hits.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
@@ -55,7 +64,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..core import memtrack, telemetry
+from ..core import autotune, memtrack, telemetry
 from .collectives import (
     all_gather,
     jit_shard_map_cached,
@@ -78,7 +87,17 @@ __all__ = [
 
 _VALID_MODES = ("auto", "gspmd", "ring")
 _RING_MIN_BYTES_DEFAULT = 1 << 20  # 1 MiB moved over the ring
+# plan-time staging admission: the ring's per-device residency (both
+# padded operands + the accumulator) may spend at most this fraction of
+# measured free HBM; beyond it the dispatcher declines to GSPMD, whose
+# fused collective degrades more gracefully under memory pressure
+_STAGING_FRACTION = 0.5
 _MODE_OVERRIDE: Optional[str] = None
+
+# static-decision reasons that mean the ring schedule is IMPOSSIBLE for
+# this layout/mesh (vs merely dispreferred) — the tuning plane never
+# second-guesses these
+_RING_IMPOSSIBLE = ("layout", "mesh1", "out-split")
 
 
 def set_mode(mode: Optional[str]) -> Optional[str]:
@@ -100,11 +119,12 @@ def _mode() -> str:
 
 
 def _ring_min_bytes() -> int:
-    raw = os.environ.get("HEAT_TPU_MATMUL_RING_MIN_BYTES", "")
-    try:
-        return int(raw) if raw else _RING_MIN_BYTES_DEFAULT
-    except ValueError:
-        return _RING_MIN_BYTES_DEFAULT
+    # one parser with HEAT_TPU_TILE_BYTES (autotune.env_bytes): a
+    # malformed value raises instead of silently running the default —
+    # an operator's typo'd threshold must not become an invisible perf bug
+    return autotune.env_bytes(
+        "HEAT_TPU_MATMUL_RING_MIN_BYTES", _RING_MIN_BYTES_DEFAULT
+    )
 
 
 def _dispatch_salt() -> tuple:
@@ -507,6 +527,33 @@ def _spec_for(comm, case, out_split, m, k, n, comp, steps, extra_axes,
     )
 
 
+@functools.lru_cache(maxsize=256)
+def _gspmd_reference(mesh, spec: _Spec):
+    """The competing arm as one jitted program: the einsum XLA/GSPMD
+    would run had the dispatcher declined, with the same epilogue tail —
+    what the explore phase times the ring program against.  Takes the
+    ring's PHYSICAL (padded) operands and slices back to logical, so both
+    arms are driven by identical inputs, and pins the ring's out-split
+    via ``out_shardings`` so GSPMD pays the same layout obligation
+    (``_ensure_split``'s resplit cost is part of what the ring wins)."""
+    m, k, n = spec.m, spec.k, spec.n
+    comp = jnp.dtype(spec.comp_dt)
+    out_spec = (
+        P() if spec.out_split is None
+        else P(spec.axis, None) if spec.out_split == 0
+        else P(None, spec.axis)
+    )
+
+    def ref(a, b, *extras):
+        out = jnp.matmul(
+            a[:m, :k].astype(comp), b[:k, :n].astype(comp),
+            precision=spec.prec,
+        )
+        return _apply_steps(out, spec.steps, extras)
+
+    return jax.jit(ref, out_shardings=NamedSharding(mesh, out_spec))
+
+
 def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
                out_split=None, *, comp_dtype=None, epilogue: Optional[Epilogue] = None,
                precision=None):
@@ -529,6 +576,43 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     use, reason, bps = _decide(
         case, out_split, m, k, n, comm.size, comp.itemsize, acc_isz
     )
+    # explore/exploit consult (core/autotune.py): in auto mode with the
+    # tuning plane live, the byte threshold above is only a prior — the
+    # first K calls per geometry run BOTH arms under measurement (below),
+    # then the measured winner overrides the threshold.  This eager entry
+    # is where exploration happens; lazy chains only consume winners.
+    tune = None
+    if (
+        reason not in _RING_IMPOSSIBLE
+        and _mode() == "auto"
+        and autotune.enabled()
+    ):
+        tune_key = autotune.matmul_key(
+            case, out_split, m, k, n, comm.size, str(comp)
+        )
+        # plan-time staging admission from measured free HBM — refuse the
+        # ring BEFORE it can RESOURCE_EXHAUST (statsless backends: None,
+        # keep the static path)
+        per_dev = (
+            (m * k + k * n) * comp.itemsize + m * n * acc_isz
+        ) // comm.size
+        granted = memtrack.suggest_budget(per_dev, fraction=_STAGING_FRACTION)
+        if granted is not None and granted < per_dev:
+            autotune.note_staging_decline(tune_key, per_dev, granted)
+            _record(
+                "gspmd", steps=0, bps=bps, out_split=out_split,
+                reason="hbm-budget",
+            )
+            return None
+        tune = autotune.decide(
+            tune_key, "ring" if use else "gspmd",
+            desc=f"{case} {m}x{k}x{n} {comp} S={comm.size}",
+        )
+        if tune.explore:
+            use, reason = True, "autotune:explore"
+        else:
+            use = tune.arm == "ring"
+            reason = "autotune:" + tune.source
     if not use:
         _record("gspmd", steps=0, bps=bps, out_split=out_split, reason=reason)
         return None
@@ -555,12 +639,43 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     )
     with telemetry.span("overlap.ring_" + case, m=m, k=k, n=n):
         fn = jit_shard_map_cached(_build_ring, comm.mesh, spec)
-        if hit:
+        if tune is not None and tune.explore:
+            # explore: measure BOTH arms — the ring program and the GSPMD
+            # reference einsum it competes with — and return the ring
+            # result (the arms are numerically interchangeable; the law
+            # tests hold them together).  One extra einsum per explore
+            # call, K calls per geometry, then the winner runs alone.
+            if hit:
+                telemetry.program_hit(ring_fp)
+            out, ring_s = autotune.timed(fn, a, b, *extras)
+            if hit:
+                # keep the roofline ledger's convention: the build call's
+                # wall (trace+compile) stays out of min/p50
+                telemetry.record_timing(ring_fp, ring_s)
+            autotune.observe(tune.key, "ring", ring_s)
+            try:
+                gfn = _gspmd_reference(comm.mesh, spec)
+                _, gspmd_s = autotune.timed(gfn, a, b, *extras)
+            except Exception:
+                # a reference arm that cannot build loses by forfeit
+                # (inf keeps the explore phase bounded)
+                gspmd_s = float("inf")
+            autotune.observe(tune.key, "gspmd", gspmd_s)
+        elif hit:
             # steady state: count the ledger hit and (sampled) wall-clock
             # the executable; the first call below traces+compiles, so
-            # its wall would pollute min/p50 and is left unmeasured
+            # its wall would pollute min/p50 and is left unmeasured.
+            # A tuned winner keeps being watched through the sampled
+            # observer — the degradation guard that re-explores a ring
+            # gone >2x slower than its recorded best.
             telemetry.program_hit(ring_fp)
-            out = telemetry.timed_call(ring_fp, fn, a, b, *extras)
+            observer = (
+                functools.partial(autotune.observe, tune.key, "ring")
+                if tune is not None else None
+            )
+            out = telemetry.timed_call(
+                ring_fp, fn, a, b, *extras, observer=observer
+            )
         else:
             out = fn(a, b, *extras)
     memtrack.register_buffer(out, tag="output", split=out_split)
@@ -665,6 +780,10 @@ def ensure_registered() -> None:
 
     fusion.register_op(_mm, "matmul", kind="matmul")
     fusion.register_terminator(_lower_chain, salt=_dispatch_salt)
+    # tuned-mode flips (a winner resolving, a cache load, an enable
+    # toggle) must build distinct fused programs — the autotune
+    # generation joins every compile-cache key
+    fusion.register_cache_salt(autotune.salt)
     _REGISTERED = True
 
 
@@ -753,6 +872,25 @@ def _lower_chain(instrs, leaves, out_slot, lshapes, gshape, split, comm,
     comp = jnp.promote_types(cast_a or a_val.dtype, cast_b or b_val.dtype)
     acc_isz = 4 if (jnp.issubdtype(comp, jnp.inexact) and comp.itemsize < 4) else comp.itemsize
     use, reason, bps = _decide(case, split, m, k, n, S, comp.itemsize, acc_isz)
+    # the chain path CONSUMES tuning state, it never explores: running
+    # both arms inside a fused program would double-execute the whole
+    # chain.  An eager explore on the same GEMM geometry warms this
+    # lookup (the key deliberately excludes the epilogue); until then the
+    # static threshold verdict stands, recorded as the prior.  The
+    # autotune generation rides the fusion compile-cache key
+    # (register_cache_salt in ensure_registered), so a winner resolving
+    # later rebuilds this chain instead of reusing the stale executable.
+    if (
+        reason not in _RING_IMPOSSIBLE
+        and _mode() == "auto"
+        and autotune.enabled()
+    ):
+        key = autotune.matmul_key(case, split, m, k, n, S, str(comp))
+        w = autotune.winner(key)
+        if w is not None:
+            use, reason = w == "ring", "autotune:cached"
+        else:
+            autotune.note_prior(key, "ring" if use else "gspmd")
     if not use:
         _record("gspmd", bps=bps, out_split=split, reason=reason)
         return None

@@ -69,7 +69,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core import guard, memtrack, telemetry
+from ..core import autotune, guard, memtrack, telemetry
 from .collectives import shard_map_unchecked
 
 __all__ = [
@@ -88,18 +88,9 @@ __all__ = [
 
 
 def _env_tile_bytes(env=None) -> int:
-    raw = (os.environ if env is None else env).get("HEAT_TPU_TILE_BYTES", "").strip()
-    if not raw:
-        return 8 << 20
-    try:
-        tb = int(raw)
-        if tb <= 0:
-            raise ValueError
-    except ValueError:
-        raise ValueError(
-            f"HEAT_TPU_TILE_BYTES must be a positive integer (bytes), got {raw!r}"
-        ) from None
-    return tb
+    # one parser with HEAT_TPU_MATMUL_RING_MIN_BYTES (autotune.env_bytes):
+    # malformed/non-positive values raise with the same message shape
+    return autotune.env_bytes("HEAT_TPU_TILE_BYTES", 8 << 20, env)
 
 
 # Per-tile staging budget. 8 MiB keeps the per-peer all_to_all/psum_scatter
@@ -184,6 +175,25 @@ def _is_oom(err: Exception) -> bool:
     )
 
 
+def _plan_tile_budget(kind: str) -> int:
+    """Plan-time tile budget: with the tuning plane live
+    (``HEAT_TPU_AUTOTUNE=on``), seed from measured free HBM UP FRONT —
+    the same :func:`memtrack.suggest_budget` formula the informed OOM
+    retry uses (quarter of free, floored), applied before the first
+    attempt so a memory-tight mesh never pays the failed allocation at
+    all.  Statsless backends (CPU) and ``HEAT_TPU_AUTOTUNE=off`` keep
+    the static ``TILE_BYTES`` default."""
+    if not autotune.enabled():
+        return TILE_BYTES
+    got = memtrack.suggest_budget(
+        TILE_BYTES, fraction=_FREE_TILE_FRACTION, floor=TILE_FLOOR_BYTES,
+    )
+    if got is None or got >= TILE_BYTES:
+        return TILE_BYTES
+    autotune.note_budget_seed("transport." + kind, got, TILE_BYTES)
+    return got
+
+
 def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
     """Run ``run(tile_bytes)`` with bounded OOM backoff: on a
     RESOURCE_EXHAUSTED failure the tile budget halves and the transfer
@@ -214,7 +224,7 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
     allocation time before donation commits, so in practice the input
     survives — but a mid-execution OOM on a donated transfer is not
     recoverable and will re-raise from the retry."""
-    tb = TILE_BYTES if tile_bytes is None else int(tile_bytes)
+    tb = _plan_tile_budget(kind) if tile_bytes is None else int(tile_bytes)
     retried = False
     with telemetry.span(f"transport.{kind}", tile_bytes=tb):
         while True:
@@ -247,9 +257,9 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
                         # staging buffer and its gathered mirror are both in
                         # flight, so claim a conservative quarter of free —
                         # but never MORE than the halving would grant
-                        informed = max(
-                            TILE_FLOOR_BYTES,
-                            min(halved, int(free * _FREE_TILE_FRACTION)),
+                        informed = memtrack.suggest_budget(
+                            halved, fraction=_FREE_TILE_FRACTION,
+                            floor=TILE_FLOOR_BYTES, free=free,
                         )
                     # a recovered OOM still leaves a forensic trail: the
                     # first failure dumps the census-bearing document

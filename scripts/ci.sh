@@ -54,6 +54,15 @@
 #                 retry must size its tile budget from the measured free
 #                 HBM, and the trace export must carry a Perfetto-shaped
 #                 memory counter track
+#  15. autotune  — self-tuning runtime (ISSUE 11): the autotune test file
+#                 at meshes 8/4/1 (explore/exploit laws, persistence
+#                 round-trip, corrupt-cache refusal, low-HBM plan
+#                 seeding, off-mode static equivalence), then a live
+#                 two-process warm start — process 1 measures both arms,
+#                 resolves winners and saves its table; process 2 loads
+#                 it via HEAT_TPU_AUTOTUNE_CACHE and must do zero
+#                 explores — and the perf-regression gate rerun with the
+#                 tuning plane on
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -66,7 +75,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/14 suite (8-device mesh)"
+say "1/15 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -75,21 +84,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/14 core subset (4-device mesh)"
+say "2/15 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/14 parity audit (exits nonzero on any gap)"
+say "3/15 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/14 multi-chip dry-run"
+say "4/15 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/14 cb smoke"
+say "5/15 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -98,10 +107,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/14 copycheck"
+say "6/15 copycheck"
 python scripts/copycheck.py
 
-say "7/14 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/15 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -117,10 +126,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/14 fusion retrace guard (second call must hit the compile cache)"
+say "8/15 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/14 guardrails (fault injection + strict-guard retrace check)"
+say "9/15 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -131,7 +140,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/14 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/15 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -139,13 +148,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/14 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/15 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/14 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/15 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -176,7 +185,7 @@ for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/14 roofline attribution + perf-regression gate"
+say "13/15 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -225,7 +234,7 @@ print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
-say "14/14 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+say "14/15 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
 # the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
 # live end-to-end forensics check: census-bearing postmortem, informed
 # first retry from measured free HBM, and the memory counter track
@@ -235,7 +244,12 @@ HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider tests/test_memtrack.py
 HEAT_TEST_DEVICES=1 \
   python -m pytest -q -p no:cacheprovider tests/test_memtrack.py
+# HEAT_TPU_AUTOTUNE=off: this check pins the classic blind-then-informed
+# retry ladder; with the tuning plane on, plan-time seeding would already
+# shrink the initial tile budget from the injected free-HBM figure and
+# the expected last_tile_bytes below would shift.
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEAT_TPU_AUTOTUNE=off \
 python - <<'EOF'
 import json, os
 os.environ["HEAT_TPU_TELEMETRY_DUMP"] = "/tmp/ci_oom_dump.json"
@@ -283,6 +297,90 @@ telemetry.set_level(prev)
 print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
       f"names the user site, informed retry at {st['last_tile_bytes']} "
       f"bytes, {len(counters)} counter samples")
+EOF
+
+say "15/15 autotune (explore/exploit laws + live two-process warm start)"
+# the self-tuning-runtime contracts (ISSUE 11) at three mesh sizes, then a
+# live warm-start check: process 1 explores, resolves winners and saves its
+# table; process 2 loads the cache at import and must do ZERO explores —
+# every decision served from the persisted table; finally the regression
+# gate must stay green with the tuning plane on (its decisions may flip
+# dispatch only where measurement says the flip is a win)
+python -m pytest -q -p no:cacheprovider \
+  tests/test_autotune.py 2>&1 | tee /tmp/ci_autotune.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_autotune.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_autotune.py
+rm -f /tmp/ci_autotune_cache.json
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events \
+python - <<'EOF'
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import autotune, fusion, telemetry
+
+# mixed geometries: one above the static ring threshold, one below — the
+# plane must measure both arms for each regardless of the old knob
+rng = np.random.default_rng(11)
+shapes = [((256, 512), (512, 1024)), ((512, 256), (256, 384))]
+with fusion.fuse(False):
+    for (sa, sb) in shapes:
+        a = ht.array(rng.random(sa).astype(np.float32), split=0)
+        b = ht.array(rng.random(sb).astype(np.float32), split=0)
+        want = np.asarray(a.larray) @ np.asarray(b.larray)
+        for _ in range(autotune.explore_k() + 2):
+            got = np.asarray(ht.matmul(a, b).resplit_(None).larray)
+            np.testing.assert_allclose(got, want, rtol=1e-4)
+
+st = autotune.stats()
+assert st["explores"] >= 2 * autotune.explore_k(), st
+decisions = [e for e in telemetry.events() if e["kind"] == "autotune_decision"]
+assert any(e["source"] == "explored" for e in decisions), decisions
+rows = autotune.report()["rows"]
+assert all(r["winner"] in ("ring", "gspmd") for r in rows), rows
+n = autotune.save("/tmp/ci_autotune_cache.json")
+assert n == len(rows) > 0, (n, rows)
+print(f"process 1: {st['explores']} explores, {n} winners persisted "
+      f"({[r['winner'] for r in rows]})")
+EOF
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events \
+HEAT_TPU_AUTOTUNE_CACHE=/tmp/ci_autotune_cache.json \
+python - <<'EOF'
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import autotune, fusion, telemetry
+
+rng = np.random.default_rng(11)
+shapes = [((256, 512), (512, 1024)), ((512, 256), (256, 384))]
+with fusion.fuse(False):
+    for (sa, sb) in shapes:
+        a = ht.array(rng.random(sa).astype(np.float32), split=0)
+        b = ht.array(rng.random(sb).astype(np.float32), split=0)
+        want = np.asarray(a.larray) @ np.asarray(b.larray)
+        for _ in range(autotune.explore_k() + 2):
+            got = np.asarray(ht.matmul(a, b).resplit_(None).larray)
+            np.testing.assert_allclose(got, want, rtol=1e-4)
+
+st = autotune.stats()
+assert st["explores"] == 0, f"warm process explored: {st}"
+assert st["cache_loads"] == 2, st
+decisions = [e for e in telemetry.events() if e["kind"] == "autotune_decision"]
+assert decisions and all(e["source"] == "cached" for e in decisions), decisions
+print(f"process 2: zero explores, {st['cache_hits']} decisions "
+      f"served from the persisted table")
+EOF
+( cd benchmarks/cb && HEAT_TPU_AUTOTUNE=on python main.py \
+  --only manipulations --check-regression --out /tmp/ci_cb_at_reg.json )
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/ci_cb_at_reg.json"))
+reg = doc["regression"]
+assert reg["rows"], "check-regression attached an empty delta table"
+assert not reg["regressions"], \
+    f"regressions with autotuning on: {reg['regressions']}"
+print(f"autotuned check-regression OK: {len(reg['rows'])} rows judged")
 EOF
 
 say "CI GREEN"

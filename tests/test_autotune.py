@@ -1,0 +1,525 @@
+"""Self-tuning runtime (ISSUE 11): explore/exploit matmul dispatch,
+HBM-seeded budgets, and the persisted warm-start cache.
+
+The suite runs with ``HEAT_TPU_AUTOTUNE=off`` (conftest default — counter
+laws elsewhere need today's static dispatch bit-for-bit); each test here
+opts back in through the API (``autotune.set_enabled(True)``) and
+restores env control on the way out.  Doctrine stays "no mocks": the
+explore tests run the real ring and GSPMD programs under measurement on
+the real mesh, the seeding tests drive the real ``memory_stats()``
+consumer through ``FaultInjector.low_hbm`` / ``memtrack.stats_override``,
+and the persistence tests round-trip real JSON files."""
+
+import json
+import os
+import tempfile
+import unittest
+
+import numpy as np
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core import autotune, fusion, memtrack, telemetry
+from heat_tpu.parallel import overlap, transport
+from heat_tpu.utils import fault
+
+from .base import TestCase
+
+_MULTI = len(jax.local_devices()) > 1
+
+# clears the ring threshold at S>=2: ag bps = ceil(512/S)/S... for S=8,
+# kb=64 → 64*1024*4 B/step × 7 steps ≈ 1.8 MiB ≥ 1 MiB
+_BIG = ((256, 512), (512, 1024))
+# stays under it: bps = 32*384*4 × 7 ≈ 336 KiB
+_SMALL = ((512, 256), (256, 384))
+
+
+class _Tuned:
+    """Scoped tuning plane: enabled via API, events level, clean
+    table/counters/recorder on both sides."""
+
+    def __init__(self, level="events"):
+        self.level = level
+
+    def __enter__(self):
+        self.prev_level = telemetry.set_level(self.level)
+        self.prev_on = autotune.set_enabled(True)
+        telemetry.reset_all()
+        telemetry.clear_events()
+        autotune.reset()
+        return self
+
+    def __exit__(self, *exc):
+        autotune.set_enabled(self.prev_on)
+        autotune.reset()
+        telemetry.reset_all()
+        telemetry.clear_events()
+        telemetry.set_level(self.prev_level)
+        return False
+
+
+def _mm_pair(shape_a=_SMALL[0], shape_b=_SMALL[1], split=0):
+    rng = np.random.default_rng(7)
+    a = ht.array(rng.random(shape_a).astype(np.float32), split=split)
+    b = ht.array(rng.random(shape_b).astype(np.float32), split=split)
+    return a, b
+
+
+def _decision_events():
+    return [e for e in telemetry.events() if e["kind"] == "autotune_decision"]
+
+
+class TestEnvBytes(TestCase):
+    """Satellite: ONE parser for byte-sized env knobs; malformed values
+    raise (transport's behavior) instead of silently defaulting
+    (overlap's old bug)."""
+
+    def test_default_and_valid(self):
+        self.assertEqual(autotune.env_bytes("X_B", 123, {}), 123)
+        self.assertEqual(autotune.env_bytes("X_B", 123, {"X_B": ""}), 123)
+        self.assertEqual(autotune.env_bytes("X_B", 123, {"X_B": " 456 "}), 456)
+
+    def test_malformed_raises_with_name(self):
+        for bad in ("lots", "-4", "0", "1.5"):
+            with self.assertRaises(ValueError) as ctx:
+                autotune.env_bytes("X_B", 123, {"X_B": bad})
+            self.assertIn("X_B must be a positive integer (bytes)", str(ctx.exception))
+
+    def test_transport_knob_unchanged(self):
+        # the pre-existing contract (test_guard.py) now served by the
+        # shared parser
+        self.assertEqual(
+            transport._env_tile_bytes({"HEAT_TPU_TILE_BYTES": "1048576"}),
+            1 << 20,
+        )
+        self.assertEqual(transport._env_tile_bytes({}), 8 << 20)
+
+    def test_ring_min_bytes_now_raises(self):
+        # the satellite fix: a typo'd threshold must surface, not silently
+        # run the 1 MiB default
+        os.environ["HEAT_TPU_MATMUL_RING_MIN_BYTES"] = "garbage"
+        try:
+            with self.assertRaises(ValueError) as ctx:
+                overlap._ring_min_bytes()
+            self.assertIn(
+                "HEAT_TPU_MATMUL_RING_MIN_BYTES must be a positive integer "
+                "(bytes)", str(ctx.exception),
+            )
+        finally:
+            del os.environ["HEAT_TPU_MATMUL_RING_MIN_BYTES"]
+        self.assertEqual(overlap._ring_min_bytes(), 1 << 20)
+
+
+class TestSuggestBudget(TestCase):
+    """Satellite: the one free-HBM budget formula behind transport retry,
+    kmeans packing, and plan-time seeding."""
+
+    def test_formula(self):
+        free = 8 << 20
+        # clamp to request / fraction of free / floor
+        self.assertEqual(
+            memtrack.suggest_budget(1 << 20, fraction=0.25, free=free), 1 << 20
+        )
+        self.assertEqual(
+            memtrack.suggest_budget(4 << 20, fraction=0.25, free=free), 2 << 20
+        )
+        self.assertEqual(
+            memtrack.suggest_budget(4 << 20, fraction=0.25, floor=3 << 20, free=free),
+            3 << 20,
+        )
+        # headroom reserved before the fraction
+        self.assertEqual(
+            memtrack.suggest_budget(
+                4 << 20, fraction=1.0, headroom=6 << 20, free=free
+            ),
+            2 << 20,
+        )
+
+    def test_matches_informed_retry_formula(self):
+        # exactly transport's informed first-retry sizing (ISSUE 10)
+        free, halved = 2 << 20, transport.TILE_BYTES >> 1
+        want = max(
+            transport.TILE_FLOOR_BYTES,
+            min(halved, int(free * transport._FREE_TILE_FRACTION)),
+        )
+        self.assertEqual(
+            memtrack.suggest_budget(
+                halved, fraction=transport._FREE_TILE_FRACTION,
+                floor=transport.TILE_FLOOR_BYTES, free=free,
+            ),
+            want,
+        )
+
+    def test_statsless_is_none(self):
+        # CPU reports no memory_stats: no fake budget, callers keep their
+        # static defaults
+        if memtrack.min_free_bytes() is None:
+            self.assertIsNone(memtrack.suggest_budget(1 << 20))
+
+    def test_override_supplies_free(self):
+        with memtrack.stats_override([
+            {"device": "fake0", "bytes_limit": 100, "bytes_in_use": 60}
+        ]):
+            self.assertEqual(
+                memtrack.suggest_budget(1000, fraction=0.5), 20
+            )
+
+    def test_kmeans_pack_budget_routes_through_helper(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.cluster import kmeans as km
+
+        arr = jnp.asarray(
+            np.random.default_rng(0).random((256, 64)), dtype=jnp.bfloat16
+        )
+        # tight free HBM (< 1 GiB headroom): the lane-pack must decline
+        with memtrack.stats_override([
+            {"device": "fake0", "bytes_limit": 1 << 30, "bytes_in_use": (1 << 30) - (64 << 20)}
+        ]):
+            self.assertIsNone(km._pack_lanes(arr))
+        # plentiful: it packs
+        with memtrack.stats_override([
+            {"device": "fake0", "bytes_limit": 8 << 30, "bytes_in_use": 1 << 20}
+        ]):
+            packed = km._pack_lanes(arr)
+        self.assertIsNotNone(packed)
+        self.assertEqual(packed[3:], (64, 2))
+
+
+class TestExploreExploit(TestCase):
+    """Tentpole site 1: both arms measured for the first K calls, winner
+    sticky by steady-state min_s, lazy chains consume (never explore)."""
+
+    @unittest.skipUnless(_MULTI, "needs a multi-device mesh")
+    def test_explore_then_sticky(self):
+        with _Tuned():
+            a, b = _mm_pair()
+            k = autotune.explore_k()
+            with fusion.fuse(False):
+                for _ in range(k + 2):
+                    out = ht.matmul(a, b)
+                    _ = out.larray
+            st = autotune.stats()
+            self.assertEqual(st["explores"], k)
+            self.assertEqual(st["cache_hits"], 2)
+            self.assertEqual(st["decisions"], k + 2)
+            self.assertEqual(st["table_size"], 1)
+            self.assertEqual(st["resolved"], 1)
+            # both arms really measured
+            (key, entry), = autotune.table().items()
+            self.assertGreaterEqual(len(entry["arms"]["ring"]), k)
+            self.assertGreaterEqual(len(entry["arms"]["gspmd"]), k)
+            self.assertIn(entry["winner"], autotune.ARMS)
+            self.assertEqual(entry["best_s"], min(entry["arms"][entry["winner"]]))
+            # the flight recorder saw the explores and the sticky phase
+            sources = [e["source"] for e in _decision_events()]
+            self.assertEqual(sources.count("explored"), k + 1)  # +1 resolution
+            self.assertEqual(sources.count("cached"), 2)
+            # numerics: explore returns the ring arm's result
+            self.assert_array_equal(
+                out, np.asarray(a.larray) @ np.asarray(b.larray), rtol=1e-4
+            )
+
+    @unittest.skipUnless(_MULTI, "needs a multi-device mesh")
+    def test_chain_consumes_winner_never_explores(self):
+        with _Tuned():
+            a, b = _mm_pair()
+            # lazy chains before any winner: static prior stands, recorded
+            out = ht.matmul(a, b) + 1.0
+            _ = out.larray
+            st = autotune.stats()
+            self.assertEqual(st["explores"], 0)
+            self.assertEqual(st["priors"], 1)
+            # resolve a winner eagerly on the same GEMM geometry
+            with fusion.fuse(False):
+                for _ in range(autotune.explore_k()):
+                    _ = ht.matmul(a, b).larray
+            self.assertEqual(autotune.stats()["resolved"], 1)
+            # the chain now lowers with the cached winner — and because the
+            # autotune generation salts the fusion cache key, it REBUILDS
+            # rather than reusing the prior-mode executable
+            out2 = ht.matmul(a, b) + 1.0
+            _ = out2.larray
+            last = overlap.stats()["last"]
+            self.assertEqual(last["reason"], "autotune:cached")
+            chain_evs = [
+                e for e in _decision_events() if e.get("site") == "chain"
+            ]
+            self.assertEqual(chain_evs[-1]["source"], "cached")
+            self.assert_array_equal(
+                out2, np.asarray(a.larray) @ np.asarray(b.larray) + 1.0,
+                rtol=1e-4,
+            )
+
+    @unittest.skipUnless(_MULTI, "needs a multi-device mesh")
+    def test_off_restores_static_dispatch(self):
+        # HEAT_TPU_AUTOTUNE=off (the conftest suite default): dispatch is
+        # exactly the byte-threshold census law — no explores, no table,
+        # no autotune events
+        prev = telemetry.set_level("events")
+        telemetry.reset_all()
+        telemetry.clear_events()
+        autotune.reset()
+        try:
+            self.assertFalse(autotune.enabled())
+            big = _mm_pair(*_BIG)
+            small = _mm_pair(*_SMALL)
+            with fusion.fuse(False):
+                for _ in range(2):
+                    _ = ht.matmul(*big).larray
+                    _ = ht.matmul(*small).larray
+            sched = overlap.stats()["by_schedule"]
+            self.assertEqual(sched["ring_ag"], 2)   # big: above threshold
+            self.assertEqual(sched["gspmd"], 2)     # small: below threshold
+            self.assertEqual(overlap.stats()["last"]["reason"], "below-threshold")
+            st = autotune.stats()
+            for c in ("decisions", "explores", "cache_hits", "priors"):
+                self.assertEqual(st[c], 0, c)
+            self.assertEqual(st["table_size"], 0)
+            self.assertEqual(_decision_events(), [])
+        finally:
+            autotune.reset()
+            telemetry.reset_all()
+            telemetry.clear_events()
+            telemetry.set_level(prev)
+
+    def test_degradation_reexplores(self):
+        # synthetic clock: a sticky winner that turns 2x slower on two
+        # consecutive sampled calls goes back to explore
+        with _Tuned():
+            key = ("fp_degrade", "test:kind")
+            for _ in range(autotune.explore_k()):
+                d = autotune.decide(key, "ring")
+                self.assertTrue(d.explore)
+                autotune.observe(key, "ring", 0.001)
+                autotune.observe(key, "gspmd", 0.002)
+            self.assertEqual(autotune.winner(key), "ring")
+            gen = autotune.salt()[2]
+            autotune.observe(key, "ring", 0.0011)   # fine: strikes stay 0
+            autotune.observe(key, "ring", 0.0030)   # strike 1
+            autotune.observe(key, "ring", 0.0012)   # recovery clears it
+            autotune.observe(key, "ring", 0.0030)   # strike 1
+            self.assertIsNotNone(autotune.winner(key))
+            autotune.observe(key, "ring", 0.0031)   # strike 2 → re-explore
+            self.assertIsNone(autotune.winner(key))
+            self.assertEqual(autotune.stats()["re_explores"], 1)
+            self.assertGreater(autotune.salt()[2], gen)
+            self.assertTrue(
+                any(e["kind"] == "autotune_reexplore" for e in telemetry.events())
+            )
+
+
+class TestPersistence(TestCase):
+    """Tentpole site 3: versioned atomic save/load; corrupt or stale
+    files fall back to a cold start with a recorded event.  Table-level
+    laws run at EVERY mesh size (ci.sh replays this file at 8/4/1)."""
+
+    def _resolve(self, key, winner="ring"):
+        slow = {"ring": 0.002, "gspmd": 0.001}
+        slow[winner] = 0.0005
+        for _ in range(autotune.explore_k()):
+            autotune.decide(key, "ring")
+            for arm in autotune.ARMS:
+                autotune.observe(key, arm, slow[arm])
+
+    def test_save_load_roundtrip(self):
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "tune.json")
+            k1 = ("fp_one", autotune.device_kind())
+            k2 = ("fp_two", autotune.device_kind())
+            self._resolve(k1, "ring")
+            self._resolve(k2, "gspmd")
+            n = autotune.save(path)
+            self.assertEqual(n, 2)
+            doc = json.load(open(path))
+            self.assertEqual(doc["version"], autotune.CACHE_VERSION)
+            self.assertEqual(doc["library"], ht.__version__)
+            autotune.reset()
+            self.assertEqual(autotune.stats()["table_size"], 0)
+            self.assertEqual(autotune.load(path), 2)
+            st = autotune.stats()
+            self.assertEqual(st["cache_loads"], 2)
+            self.assertEqual(st["fallbacks"], 0)
+            self.assertEqual(autotune.winner(k1), "ring")
+            self.assertEqual(autotune.winner(k2), "gspmd")
+            # loaded entries serve decisions without exploring
+            d = autotune.decide(k1, "gspmd")
+            self.assertEqual((d.arm, d.source, d.explore), ("ring", "cached", False))
+            row = [r for r in autotune.report()["rows"] if r["fingerprint"] == "fp_one"][0]
+            self.assertEqual(row["source"], "cached")
+
+    def test_corrupt_and_stale_ignored_with_fallback_event(self):
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            cases = {
+                "not_json.json": "{nope",
+                "not_object.json": json.dumps([1, 2]),
+                "stale_version.json": json.dumps(
+                    {"version": 999, "library": ht.__version__, "entries": []}
+                ),
+                "other_library.json": json.dumps(
+                    {"version": autotune.CACHE_VERSION, "library": "9.9.9",
+                     "entries": []}
+                ),
+                "bad_arm.json": json.dumps(
+                    {"version": autotune.CACHE_VERSION,
+                     "library": ht.__version__,
+                     "entries": [{"fingerprint": "f", "device_kind": "d",
+                                  "winner": "quantum"}]}
+                ),
+            }
+            for i, (name, content) in enumerate(cases.items(), 1):
+                path = os.path.join(td, name)
+                with open(path, "w") as f:
+                    f.write(content)
+                self.assertEqual(autotune.load(path), 0, name)
+                self.assertEqual(autotune.stats()["fallbacks"], i, name)
+                self.assertEqual(autotune.stats()["table_size"], 0, name)
+            evs = [e for e in telemetry.events() if e["kind"] == "fallback"
+                   and e.get("site") == "autotune.load"]
+            self.assertEqual(len(evs), len(cases))
+            self.assertTrue(all(e["error"] for e in evs))
+
+    def test_save_is_atomic(self):
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "tune.json")
+            self._resolve(("fp_a", "dk"))
+            autotune.save(path)
+            self.assertEqual(os.listdir(td), ["tune.json"])  # no tmp litter
+
+    @unittest.skipUnless(_MULTI, "needs a multi-device mesh")
+    def test_warm_start_zero_explores(self):
+        # the acceptance law, in-process: a table resolved by process 1
+        # lets the same workload replay with ZERO explore calls (the
+        # two-OS-process version runs in ci.sh stage 15)
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "tune.json")
+            a, b = _mm_pair()
+            with _Tuned():
+                with fusion.fuse(False):
+                    for _ in range(autotune.explore_k() + 1):
+                        _ = ht.matmul(a, b).larray
+                self.assertGreater(autotune.stats()["explores"], 0)
+                autotune.save(path)
+            with _Tuned():
+                autotune.load(path)
+                with fusion.fuse(False):
+                    for _ in range(3):
+                        _ = ht.matmul(a, b).larray
+                st = autotune.stats()
+                self.assertEqual(st["explores"], 0)
+                self.assertEqual(st["cache_hits"], 3)
+                self.assertTrue(
+                    all(e["source"] == "cached" for e in _decision_events())
+                )
+
+
+class TestHBMSeeding(TestCase):
+    """Tentpole site 2: budgets seeded from measured free HBM at plan
+    time — before the first RESOURCE_EXHAUSTED, not after it."""
+
+    @unittest.skipUnless(_MULTI, "needs a multi-device mesh")
+    def test_low_hbm_seeds_transport_tile_budget(self):
+        with _Tuned():
+            free = 2 << 20
+            inj = fault.FaultInjector(seed=0).low_hbm(free)
+            with fault.injected(inj):
+                x = ht.arange(16 * 64, dtype=ht.float32, split=0).reshape((16, 64))
+                x.resplit_(1)
+            st = transport.stats()
+            want = max(
+                transport.TILE_FLOOR_BYTES,
+                min(transport.TILE_BYTES,
+                    int(free * transport._FREE_TILE_FRACTION)),
+            )
+            self.assertEqual(st["last_tile_bytes"], want)
+            self.assertEqual(st["oom_retries"], 0)  # seeded, not recovered
+            self.assertGreaterEqual(autotune.stats()["budget_seeds"], 1)
+            evs = [e for e in telemetry.events() if e["kind"] == "autotune_budget"]
+            self.assertTrue(evs)
+            self.assertEqual(evs[0]["budget"], want)
+
+    @unittest.skipUnless(_MULTI, "needs a multi-device mesh")
+    def test_off_keeps_static_tile_budget(self):
+        # same injected pressure, tuning plane off: today's static budget
+        inj = fault.FaultInjector(seed=0).low_hbm(2 << 20)
+        transport.reset_stats()
+        try:
+            with fault.injected(inj):
+                x = ht.arange(16 * 64, dtype=ht.float32, split=0).reshape((16, 64))
+                x.resplit_(1)
+            self.assertEqual(
+                transport.stats()["last_tile_bytes"], transport.TILE_BYTES
+            )
+        finally:
+            transport.reset_stats()
+
+    @unittest.skipUnless(_MULTI, "needs a multi-device mesh")
+    def test_ring_staging_declined_under_pressure(self):
+        with _Tuned():
+            a, b = _mm_pair(*_BIG)
+            inj = fault.FaultInjector(seed=0).low_hbm(64 << 10)
+            with fault.injected(inj):
+                with fusion.fuse(False):
+                    out = ht.matmul(a, b)
+            # ring refused up front; the GSPMD fallback still computes
+            self.assertEqual(overlap.stats()["last"]["reason"], "hbm-budget")
+            self.assertGreaterEqual(autotune.stats()["staging_declines"], 1)
+            self.assertEqual(autotune.stats()["explores"], 0)
+            self.assert_array_equal(
+                out, np.asarray(a.larray) @ np.asarray(b.larray), rtol=1e-4
+            )
+
+
+class TestOpsSurface(TestCase):
+    """Satellite: Prometheus gauges + the report table."""
+
+    def test_prometheus_gauges(self):
+        with _Tuned():
+            self._seed_one()
+            text = telemetry.export_prometheus()
+            for fam in (
+                "heat_tpu_autotune_table_size",
+                "heat_tpu_autotune_explores",
+                "heat_tpu_autotune_cache_hits",
+                "heat_tpu_autotune_cache_loads",
+            ):
+                self.assertIn(fam, text)
+            line = [l for l in text.splitlines()
+                    if l.startswith("heat_tpu_autotune_table_size")][0]
+            self.assertEqual(line.split()[-1], "1")
+
+    def _seed_one(self):
+        key = ("fp_prom", "test:kind")
+        for _ in range(autotune.explore_k()):
+            autotune.decide(key, "ring")
+            autotune.observe(key, "ring", 0.001)
+            autotune.observe(key, "gspmd", 0.002)
+
+    def test_report_shape(self):
+        with _Tuned():
+            self._seed_one()
+            rep = telemetry.autotune_report()
+            self.assertTrue(rep["enabled"])
+            self.assertEqual(len(rep["rows"]), 1)
+            row = rep["rows"][0]
+            self.assertEqual(row["winner"], "ring")
+            self.assertEqual(row["source"], "explored")
+            self.assertEqual(row["ring_min_s"], 0.001)
+            self.assertEqual(row["gspmd_min_s"], 0.002)
+            self.assertEqual(rep["stats"]["resolved"], 1)
+
+    def test_explore_k_env(self):
+        self.assertEqual(autotune.explore_k(), 3)
+        os.environ["HEAT_TPU_AUTOTUNE_EXPLORE"] = "5"
+        try:
+            self.assertEqual(autotune.explore_k(), 5)
+            os.environ["HEAT_TPU_AUTOTUNE_EXPLORE"] = "zero"
+            with self.assertRaises(ValueError):
+                autotune.explore_k()
+        finally:
+            del os.environ["HEAT_TPU_AUTOTUNE_EXPLORE"]
+
+
+if __name__ == "__main__":
+    unittest.main()
