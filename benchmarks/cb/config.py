@@ -172,4 +172,16 @@ MOE_T, MOE_D, MOE_H = (16_384, 1024, 4096) if ON_TPU else (512, 64, 128)
 # 5e5x1e3 f32: the fit holds x, its unit-norm copy and intermediates — ~8 GB
 # peak of a 16 GB v5e; 1e6 rows would OOM during the normalization
 LASSO_M, LASSO_N = (500_000, 1_000) if ON_TPU else (2_000, 32)
+
+# ---- kernel-tier rows (round 15): the autotune-dispatched Pallas arms.
+# reshape_repack: a narrow-minor split-0 reshape with pad-carrying source
+# shards (rows % mesh != 0); on TPU the r05 row measured ~4.4% of roofline
+# through the padded classic store.  qr_panel: tall-skinny CholeskyQR2
+# whose leaf panel fits the fused kernel's VMEM budget (n_pad <= 512).
+# lasso_sweep: the tallest residual the fused sweep accepts (m_pad 8192).
+REPACK_IN, REPACK_OUT = (
+    ((999_999, 20), (1_999_998, 10)) if ON_TPU else ((9_999, 20), (19_998, 10))
+)
+QR_PANEL_M, QR_PANEL_N = (262_144, 256) if ON_TPU else (4_096, 128)
+LASSO_K_M, LASSO_K_N = (8_192, 512) if ON_TPU else (2_000, 32)
 RESNET_BATCH, RESNET_IMG = (256, 224) if ON_TPU else (8, 32)

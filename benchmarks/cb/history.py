@@ -54,6 +54,14 @@ TOLERANCE = {
     "kmedoids": 0.4,
     # single-run with one deliberate host sync (qr.py breakdown check)
     "tsqr_user_call": 0.4,
+    # round-15 kernel-tier rows: each is measured from a COLD tuning
+    # table (kernels.py clears it), so the timed region includes the
+    # explore phase running BOTH arms back to back — their notes record
+    # the measured arm choice, and the wall rides which arm won and how
+    # quickly the table resolved
+    "reshape_repack": 0.5,
+    "qr_panel_fused": 0.5,
+    "lasso_sweep_fused": 0.5,
 }
 
 _ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")

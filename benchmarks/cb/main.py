@@ -12,6 +12,7 @@ import cluster
 import config
 import fusion
 import history
+import kernels
 import linalg
 import manipulations
 import nn
@@ -87,7 +88,8 @@ if __name__ == "__main__":
     ap.add_argument(
         "--only",
         default=None,
-        help="comma-separated subset: linalg,cluster,manipulations,nn,regression,fusion",
+        help="comma-separated subset: "
+             "linalg,cluster,manipulations,nn,regression,fusion,kernels",
     )
     ap.add_argument(
         "--check-regression",
@@ -103,6 +105,7 @@ if __name__ == "__main__":
         "linalg": linalg.run,
         "cluster": cluster.run,
         "fusion": fusion.run,
+        "kernels": kernels.run,
         "manipulations": manipulations.run,
         "nn": nn.run,
         "regression": regression.run,

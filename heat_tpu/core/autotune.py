@@ -56,11 +56,13 @@ __all__ = [
     "ARMS",
     "CACHE_VERSION",
     "Decision",
+    "KERNEL_ARMS",
     "decide",
     "device_kind",
     "enabled",
     "env_bytes",
     "explore_k",
+    "kernel_key",
     "load",
     "matmul_key",
     "note_budget_seed",
@@ -77,6 +79,13 @@ __all__ = [
 ]
 
 ARMS = ("ring", "gspmd")
+# round 15: Pallas kernels join the explore set as per-site arm pairs —
+# "classic" is whatever the site dispatched before this round (ROADMAP
+# item 2 predicted exactly this extension)
+KERNEL_ARMS = ("classic", "kernel")
+# every arm name any entry may carry; load() refuses winners outside it
+# so a corrupt cache cannot inject an undispatched arm
+_KNOWN_ARMS = frozenset(ARMS) | frozenset(KERNEL_ARMS)
 CACHE_VERSION = 1
 
 # samples kept per arm (min_s over a bounded window; enough for the
@@ -226,11 +235,11 @@ def salt() -> tuple:
     return ("autotune", enabled(), _GENERATION[0])
 
 
-def _entry(key: Tuple[str, str], desc: str = "") -> dict:
+def _entry(key: Tuple[str, str], desc: str = "", arms: Tuple[str, ...] = ARMS) -> dict:
     e = _TABLE.get(key)
     if e is None:
         e = _TABLE[key] = {
-            "arms": {a: [] for a in ARMS},
+            "arms": {a: [] for a in arms},
             "winner": None,
             "best_s": None,
             "strikes": 0,
@@ -265,8 +274,7 @@ def winner(key: Tuple[str, str]) -> Optional[str]:
 
 def _arm_times(e: dict) -> Dict[str, Optional[float]]:
     out: Dict[str, Optional[float]] = {}
-    for a in ARMS:
-        d = e["arms"][a]
+    for a, d in e["arms"].items():
         out[a + "_min_s"] = round(min(d), 6) if d else None
     return out
 
@@ -305,6 +313,16 @@ def matmul_key(
     return fp, device_kind()
 
 
+def kernel_key(site: str, *geometry) -> Tuple[str, str]:
+    """Tuning-table key for one Pallas-kernel dispatch site
+    (``reshape_repack`` / ``qr_panel`` / ``lasso_sweep``) at one
+    geometry.  The entry's arms are :data:`KERNEL_ARMS` — "classic" (the
+    pre-round-15 lowering) vs "kernel" (the Pallas arm); both are
+    measured by the same explore/exploit machinery as ring-vs-GSPMD."""
+    fp = telemetry.fingerprint(("kernel", site) + tuple(geometry))
+    return fp, device_kind()
+
+
 # ---------------------------------------------------------------- decisions
 
 
@@ -316,12 +334,19 @@ class Decision(NamedTuple):
     key: Tuple[str, str]
 
 
-def decide(key: Tuple[str, str], prior_arm: str, desc: str = "") -> Decision:
+def decide(
+    key: Tuple[str, str],
+    prior_arm: str,
+    desc: str = "",
+    arms: Tuple[str, ...] = ARMS,
+) -> Decision:
     """One dispatch consult at the eager engine entry.  While either arm
     has fewer than :func:`explore_k` samples the call explores (runs
     both arms); a resolved entry serves its winner; the caller's static
-    threshold verdict rides along as the prior."""
-    e = _entry(key, desc)
+    threshold verdict rides along as the prior.  ``arms`` names the
+    entry's arm set on first touch (:data:`ARMS` for ring-vs-GSPMD,
+    :data:`KERNEL_ARMS` for the Pallas kernel sites)."""
+    e = _entry(key, desc, arms)
     if e["winner"] is not None:
         _STATS["decisions"] += 1
         _STATS["cache_hits"] += 1
@@ -337,8 +362,7 @@ def decide(key: Tuple[str, str], prior_arm: str, desc: str = "") -> Decision:
         "autotune_decision",
         fingerprint=key[0], device_kind=key[1], arm=prior_arm,
         source="explored", explore=True,
-        ring_samples=len(e["arms"]["ring"]),
-        gspmd_samples=len(e["arms"]["gspmd"]),
+        **{a + "_samples": len(d) for a, d in e["arms"].items()},
     )
     return Decision(prior_arm, "explored", True, key)
 
@@ -379,7 +403,7 @@ def observe(key: Tuple[str, str], arm: str, dur_s: float) -> None:
                     arm=arm, observed_s=round(dur_s, 6),
                     best_s=round(e["best_s"], 6),
                 )
-                e["arms"] = {a: [] for a in ARMS}
+                e["arms"] = {a: [] for a in e["arms"]}
                 e["winner"] = None
                 e["best_s"] = None
                 e["strikes"] = 0
@@ -392,8 +416,8 @@ def observe(key: Tuple[str, str], arm: str, dur_s: float) -> None:
     durs.append(float(dur_s))
     del durs[:-_MAX_SAMPLES]
     k = explore_k()
-    if all(len(e["arms"][a]) >= k for a in ARMS):
-        mins = {a: min(e["arms"][a]) for a in ARMS}
+    if all(len(d) >= k for d in e["arms"].values()):
+        mins = {a: min(d) for a, d in e["arms"].items()}
         e["winner"] = min(mins, key=mins.get)
         e["best_s"] = mins[e["winner"]]
         e["strikes"] = 0
@@ -509,15 +533,25 @@ def load(path) -> int:
         parsed = []
         for ent in entries:
             w = ent.get("winner")
-            if w is not None and w not in ARMS:
+            if w is not None and w not in _KNOWN_ARMS:
                 raise ValueError(f"unknown arm {w!r}")
+            # the entry's own arm set round-trips (ring/gspmd AND
+            # classic/kernel entries share one cache file); arm names
+            # outside the registry poison the whole file — a winner
+            # this build cannot dispatch must not warm-start anything
+            arm_names = tuple(ent.get("arms", {})) or ARMS
+            for a in arm_names:
+                if a not in _KNOWN_ARMS:
+                    raise ValueError(f"unknown arm {a!r}")
+            if w is not None and w not in arm_names:
+                raise ValueError(f"winner {w!r} outside entry arms")
             parsed.append((
                 (str(ent["fingerprint"]), str(ent["device_kind"])),
                 w,
                 ent.get("best_s"),
                 str(ent.get("desc") or ""),
                 {a: [float(t) for t in ent.get("arms", {}).get(a, [])]
-                 for a in ARMS},
+                 for a in arm_names},
             ))
     except Exception as exc:
         _STATS["fallbacks"] += 1
@@ -579,8 +613,7 @@ def report(top: Optional[int] = None) -> dict:
     then by fingerprint."""
     rows = []
     for (fp, dk), e in _TABLE.items():
-        times = _arm_times(e)
-        rows.append({
+        row = {
             "fingerprint": fp,
             "device_kind": dk,
             "desc": e["desc"],
@@ -588,11 +621,15 @@ def report(top: Optional[int] = None) -> dict:
             "source": ("cached" if e["loaded"] else
                        "explored" if e["winner"] else "prior"),
             "best_s": _finite(e["best_s"]),
-            "ring_min_s": times["ring_min_s"],
-            "gspmd_min_s": times["gspmd_min_s"],
-            "ring_samples": len(e["arms"]["ring"]),
-            "gspmd_samples": len(e["arms"]["gspmd"]),
-        })
+            "arms": tuple(e["arms"]),
+        }
+        # per-arm columns keyed by the entry's own arm set:
+        # ring_min_s/gspmd_min_s for matmul rows, classic_min_s/
+        # kernel_min_s for the Pallas kernel sites
+        row.update(_arm_times(e))
+        for a, d in e["arms"].items():
+            row[a + "_samples"] = len(d)
+        rows.append(row)
     rows.sort(key=lambda r: (r["winner"] is None, r["fingerprint"]))
     if top is not None:
         rows = rows[:int(top)]

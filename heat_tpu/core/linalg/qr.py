@@ -25,8 +25,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .. import sanitation, types
+from .. import autotune, sanitation, telemetry, types
 from ..dndarray import DNDarray, _ensure_split
+from ...ops import qr_panel
 from ...parallel.collectives import shard_map_unchecked as _shard_map
 
 __all__ = ["qr", "orthogonality_defect"]
@@ -127,8 +128,8 @@ def _tsqr(a: DNDarray, calc_q: bool = True):
     return _ensure_split(q_ht, 0), r_ht
 
 
-@functools.partial(jax.jit, static_argnames=("calc_q", "mixed"))
-def _cholesky_qr2(arr, calc_q: bool = True, mixed: bool = False):
+@functools.partial(jax.jit, static_argnames=("calc_q", "mixed", "kernel"))
+def _cholesky_qr2(arr, calc_q: bool = True, mixed: bool = False, kernel: str = ""):
     """CholeskyQR2: tall-skinny QR as pure MXU matmuls.
 
     XLA's Householder QR runs at ~0.1 TFLOP/s on TPU (sequential panel
@@ -146,7 +147,13 @@ def _cholesky_qr2(arr, calc_q: bool = True, mixed: bool = False):
     orthogonality to f32 level (measured ~4e-5 for n=512 vs ~1e-5 full-f32)
     while the reconstruction ``A - QR`` is bf16-working-precision (~2e-3
     relative) because R1 derives from the bf16 Gram.  ~2.2x faster on v5e
-    (the pass-1 GEMMs ride the MXU at bf16 rate)."""
+    (the pass-1 GEMMs ride the MXU at bf16 rate).
+
+    ``kernel`` (``""``/``"tpu"``/``"interpret"``, static) routes the
+    f32 panel passes through the fused Pallas syrk+chol+trsm kernel
+    (``ops/qr_panel.py``) instead of the three-launch chain; bf16 pass-1
+    (``mixed``) always stays classic.  Callers gate on
+    ``qr_panel.panel_mode`` — the autotune ``kernel`` arm in :func:`qr`."""
     eye = jnp.eye(arr.shape[1], dtype=arr.dtype)
 
     def gram_chol(x, lowp):
@@ -165,6 +172,13 @@ def _cholesky_qr2(arr, calc_q: bool = True, mixed: bool = False):
         return jnp.linalg.cholesky(g)
 
     def chol_step(x, lowp=False):
+        if kernel and not lowp:
+            # fused panel pass: one launch, G stays in VMEM
+            r, rinv = qr_panel.fused_gram_chol(
+                x, interpret=(kernel == "interpret")
+            )
+            q = jnp.matmul(x, rinv, precision=jax.lax.Precision.HIGHEST)
+            return q, r
         l = gram_chol(x, lowp)
         rinv = jax.lax.linalg.triangular_solve(l, eye, lower=True, left_side=True).T
         if lowp:
@@ -182,13 +196,19 @@ def _cholesky_qr2(arr, calc_q: bool = True, mixed: bool = False):
     else:
         # R-only: the second pass still needs R2 = chol(Q1ᵀQ1)ᵀ for the
         # orthogonality-corrected R, but the tall Q1·R2⁻¹ GEMM is skipped
-        q, r2 = None, gram_chol(q1, False).T
+        if kernel:
+            r2 = qr_panel.fused_gram_chol(
+                q1, interpret=(kernel == "interpret")
+            )[0]
+            q = None
+        else:
+            q, r2 = None, gram_chol(q1, False).T
     r = jnp.matmul(r2, r1, precision=jax.lax.Precision.HIGHEST)
     return q, r
 
 
-@functools.partial(jax.jit, static_argnames=("mixed", "calc_q"))
-def _blocked_qr(arr, mixed: bool = False, calc_q: bool = True):
+@functools.partial(jax.jit, static_argnames=("mixed", "calc_q", "kernel"))
+def _blocked_qr(arr, mixed: bool = False, calc_q: bool = True, kernel: str = ""):
     """Blocked QR for square-ish matrices (m >= n) as pure GEMMs.
 
     XLA's Householder QR runs ~0.1-1 TFLOP/s on TPU (sequential panel
@@ -206,12 +226,12 @@ def _blocked_qr(arr, mixed: bool = False, calc_q: bool = True):
     """
     m, n = arr.shape
     if m >= 2 * n:
-        return _cholesky_qr2(arr, calc_q=calc_q, mixed=mixed)
+        return _cholesky_qr2(arr, calc_q=calc_q, mixed=mixed, kernel=kernel)
     n1 = n // 2
     a1, a2 = arr[:, :n1], arr[:, n1:]
     # q1 is always needed (it orthogonalizes the right block); only the
     # RIGHTMOST leaf's Q is skippable for R-only factorizations
-    q1, r11 = _blocked_qr(a1, mixed=mixed)
+    q1, r11 = _blocked_qr(a1, mixed=mixed, kernel=kernel)
 
     def proj(q, x):
         # contract dim 0 directly: qᵀx without materializing qᵀ
@@ -225,7 +245,7 @@ def _blocked_qr(arr, mixed: bool = False, calc_q: bool = True):
     t2 = proj(q1, a2)  # reorthogonalize: CGS2
     a2 = a2 - jnp.matmul(q1, t2, precision=hi)
     r12 = t1 + t2
-    q2, r22 = _blocked_qr(a2, mixed=mixed, calc_q=calc_q)
+    q2, r22 = _blocked_qr(a2, mixed=mixed, calc_q=calc_q, kernel=kernel)
     q = jnp.concatenate([q1, q2], axis=1) if calc_q else None
     r = jnp.block([
         [r11, r12],
@@ -303,12 +323,49 @@ def qr(
         # tall: CholeskyQR2 directly; square-ish: blocked BCGS2 over
         # CholeskyQR2 panels (round 5 — the jnp.linalg.qr fallback ran the
         # reference-CI square shape at 2.4% MFU, ~10x below the GEMM path)
-        if m >= 2 * n:
-            q, r = _cholesky_qr2(arr, calc_q=calc_q, mixed=(precision == "mixed"))
-        else:
-            q, r = _blocked_qr(
-                arr, mixed=(precision == "mixed"), calc_q=calc_q
+        mx = precision == "mixed"
+
+        def fact(km: str = ""):
+            if m >= 2 * n:
+                return _cholesky_qr2(arr, calc_q=calc_q, mixed=mx, kernel=km)
+            return _blocked_qr(arr, mixed=mx, calc_q=calc_q, kernel=km)
+
+        # round 15: the fused syrk+chol+trsm panel kernel as a measured
+        # autotune arm — explore times BOTH lowerings (and returns the
+        # classic result so numerics never depend on tuning state), then
+        # the per-geometry winner sticks with a degradation watch
+        kmode = qr_panel.panel_mode(m, n, arr.dtype, mx, a.split, nshards)
+        if kmode != "off" and autotune.enabled():
+            dt = str(arr.dtype)
+            fp_k = telemetry.fingerprint(
+                ("qr_panel_fused", m, n, dt, calc_q)
             )
+            telemetry.ensure_program(
+                fp_k, kind="kernel_qr_panel", ops=1,
+                flops=4.0 * m * n * n,
+                hbm_bytes=3.0 * m * n * arr.dtype.itemsize,
+                mesh={"devices": nshards}, dtype=dt,
+            )
+            key = autotune.kernel_key("qr_panel", m, n, dt, calc_q, nshards)
+            d = autotune.decide(
+                key, "classic", desc=f"qr {m}x{n} {dt}",
+                arms=autotune.KERNEL_ARMS,
+            )
+            if d.explore:
+                (q, r), t_c = autotune.timed(fact)
+                _, t_k = autotune.timed(fact, kmode)
+                autotune.observe(key, "classic", t_c)
+                autotune.observe(key, "kernel", t_k)
+                telemetry.record_timing(fp_k, t_k)
+            elif d.arm == "kernel":
+                q, r = telemetry.timed_call(
+                    fp_k, fact, kmode,
+                    observer=functools.partial(autotune.observe, key, "kernel"),
+                )
+            else:
+                q, r = fact()
+        else:
+            q, r = fact()
         # "eager": one deliberate host sync per factorization call: the
         # breakdown check (failed Cholesky cascades NaNs into R) costs one
         # scalar readback, traded against never silently returning garbage

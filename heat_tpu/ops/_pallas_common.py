@@ -1,0 +1,93 @@
+"""Shared Pallas plumbing for every kernel in :mod:`heat_tpu.ops`.
+
+Rounds 4–8 grew three Pallas kernels (matmul/cdist/attention) that each
+carried a private copy of the same three pieces: the compiler-params
+version shim, the ``HEAT_TPU_PALLAS`` mode selection, and lane/sublane
+pad helpers.  Round 15 adds three more kernels (repack, fused
+CholeskyQR2 panel, fused lasso sweep), so the boilerplate moves here
+once and all six route through it.
+
+Mode contract (unchanged from PR 4): ``HEAT_TPU_PALLAS`` forces
+``interpret`` / ``tpu`` / ``off``; unset picks ``tpu`` on a TPU backend
+and ``off`` elsewhere (tests run the kernels on CPU through the Pallas
+interpreter by exporting ``HEAT_TPU_PALLAS=interpret``).
+
+Per-kernel kill switches: the round-15 kernels are *autotune dispatch
+arms*, so each also honors its own env knob
+(``HEAT_TPU_KERNEL_REPACK`` / ``_QR`` / ``_LASSO`` = ``off``) via
+:func:`kernel_enabled` — an operator can disable one kernel family
+without touching the others or the Pallas tier as a whole.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "LANE",
+    "kernel_enabled",
+    "kernel_mode",
+    "mode",
+    "pad_to",
+    "sublane",
+    "tpu_compiler_params",
+]
+
+# VPU/MXU lane width: the minor-most tile dimension on every TPU
+# generation this library targets (pallas_guide: min tile (8,128) f32).
+LANE = 128
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas TPU compiler params across the API drift: the class is
+    ``CompilerParams`` on jax>=0.6.1 but ``TPUCompilerParams`` before —
+    the version-dispatch twin of ``collectives.shard_map_unchecked``."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def mode() -> str:
+    """Pallas execution mode: ``tpu`` | ``interpret`` | ``off``."""
+    forced = os.environ.get("HEAT_TPU_PALLAS", "")
+    if forced in ("interpret", "tpu", "off"):
+        return forced
+    return "tpu" if jax.default_backend() == "tpu" else "off"
+
+
+def kernel_enabled(name: str) -> bool:
+    """Per-kernel kill switch: ``HEAT_TPU_KERNEL_<NAME>`` in
+    ``off/0/false/no`` disables that kernel family (it stops registering
+    as an autotune arm; dispatch is restored bit-for-bit)."""
+    raw = os.environ.get(f"HEAT_TPU_KERNEL_{name.upper()}", "").strip().lower()
+    return raw not in ("off", "0", "false", "no")
+
+
+def kernel_mode(name: str) -> str:
+    """Mode for one gated kernel family: :func:`mode` unless the
+    family's kill switch turned it ``off``."""
+    if not kernel_enabled(name):
+        return "off"
+    return mode()
+
+
+def sublane(dtype) -> int:
+    """Minimum second-minor tile extent for ``dtype`` (pallas_guide:
+    (8,128) f32, (16,128) bf16, (32,128) int8/fp8)."""
+    dt = jnp.dtype(dtype)
+    if dt.itemsize == 2:
+        return 16
+    if dt.itemsize == 1:
+        return 32
+    return 8
+
+
+def pad_to(x: jax.Array, mults) -> jax.Array:
+    """Zero-pad each dim of ``x`` up to a multiple of ``mults[d]``."""
+    pads = [(0, (-d) % m) for d, m in zip(x.shape, mults)]
+    if any(p[1] for p in pads):
+        x = jnp.pad(x, pads)
+    return x

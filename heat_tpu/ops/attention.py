@@ -25,9 +25,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .matmul import tpu_compiler_params
+from ._pallas_common import tpu_compiler_params
 
-from .matmul import _mode
+from ._pallas_common import mode as _mode
 
 __all__ = ["flash_attention"]
 

@@ -21,9 +21,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .matmul import tpu_compiler_params
+from ._pallas_common import tpu_compiler_params
 
-from .matmul import _mode, _pad_to
+from ._pallas_common import mode as _mode
+from ._pallas_common import pad_to as _pad_to
 
 __all__ = ["cdist"]
 

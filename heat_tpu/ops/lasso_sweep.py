@@ -1,0 +1,146 @@
+"""Fused coordinate-descent sweep kernel for LASSO.
+
+The measured problem (ROADMAP item 3): a CD sweep is n_features
+dependent steps of two length-m vector ops (``rho = xⱼ·(r + θⱼxⱼ)``
+and the rank-1 residual update), each arithmetically trivial.  XLA
+compiles the ``fori_loop`` into a sequential program that re-streams
+the residual from HBM every coordinate — ~3 length-m HBM round trips
+per coordinate, pure memory-bound tail.
+
+This kernel keeps the residual **resident in VMEM across the entire
+sweep**: the grid walks 128-wide coordinate blocks ("arbitrary"
+semantics — sequential, VMEM scratch carries over), each step loads one
+``(m, 128)`` column panel of X, and an inner ``fori_loop`` runs the 128
+dependent coordinate updates against the in-VMEM residual.  Per sweep,
+X is read exactly once and the residual never touches HBM.
+
+Numerics: identical update order and f32 arithmetic as the classic
+``_cd_sweep`` (``regression/lasso.py``) — the intercept (coordinate 0)
+stays unpenalized, pad coordinates/rows are masked no-ops.  Dispatched
+as the ``kernel`` autotune arm behind ``Lasso.fit``: measured per
+geometry, safe decline on sharded operands, non-f32 dtypes, and
+residuals too tall for VMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._pallas_common import LANE, kernel_mode, pad_to, tpu_compiler_params
+
+__all__ = ["sweep", "sweep_mode"]
+
+# X column panel (m_pad x 128 f32) + residual scratch must fit VMEM
+_MAX_M_PAD = 8192
+
+
+def sweep_mode(m: int, n: int, dtype, split, nshards: int) -> str:
+    """Dispatch mode for one ``Lasso.fit`` geometry: ``tpu`` /
+    ``interpret`` when the fused sweep applies, ``off`` otherwise.
+
+    Safe declines: non-f32 dtypes, sharded design matrices (the kernel
+    is a single-device program), residuals taller than the VMEM budget,
+    and degenerate shapes.  Tiny problems decline too — launch overhead
+    dwarfs the win — unless the operator forced the Pallas tier
+    (``HEAT_TPU_PALLAS``, the cdist skinny-decline precedent)."""
+    if jnp.dtype(dtype) != jnp.dtype(jnp.float32):
+        return "off"
+    if split is not None and nshards > 1:
+        return "off"
+    if m < 1 or n < 2:
+        return "off"
+    if -(-m // 8) * 8 > _MAX_M_PAD:
+        return "off"
+    forced = os.environ.get("HEAT_TPU_PALLAS", "") in ("interpret", "tpu")
+    if not forced and m * n < 1 << 16:
+        return "off"
+    return kernel_mode("lasso")
+
+
+def _sweep_kernel(m_true, n_true, x_ref, th_ref, r0_ref, lam_ref, o_ref, r_ref):
+    j_blk = pl.program_id(0)
+
+    @pl.when(j_blk == 0)
+    def _():
+        r_ref[:] = r0_ref[:].astype(jnp.float32)
+
+    lam = lam_ref[0, 0]
+    X = x_ref[:].astype(jnp.float32)  # (m_pad, LANE) column panel
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANE), 1)
+    m = jnp.float32(m_true)
+
+    # 128 dependent coordinate updates against the in-VMEM residual;
+    # masked lane extraction (2-D iota — TPU has no 1-D iota).  Pad
+    # rows of X are zero so rho sums only real rows; pad coordinates
+    # (jg >= n_true) are forced to zero and cannot move the residual.
+    def body(jl, carry):
+        th, r = carry
+        lm = lane == jl
+        xj = jnp.sum(jnp.where(lm, X, 0.0), axis=1, keepdims=True)
+        thj = jnp.sum(jnp.where(lm, th, 0.0))
+        rho = jnp.sum(xj * (r + thj * xj)) / m
+        jg = j_blk * LANE + jl
+        # intercept (global coordinate 0) unpenalized — reference
+        # lasso.py:100 and the classic _cd_sweep agree
+        new = jnp.where(
+            jg == 0,
+            rho,
+            jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - lam, 0.0),
+        )
+        new = jnp.where(jg < n_true, new, 0.0)
+        r = r + (thj - new) * xj
+        th = jnp.where(lm, new, th)
+        return th, r
+
+    th0 = th_ref[:].astype(jnp.float32)
+    th, r = jax.lax.fori_loop(0, LANE, body, (th0, r_ref[:]))
+    r_ref[:] = r
+    o_ref[:] = th.astype(o_ref.dtype)
+
+
+def sweep(X: jax.Array, y: jax.Array, theta: jax.Array, lam, *,
+          interpret: bool = False) -> jax.Array:
+    """One fused CD sweep: the drop-in counterpart of ``_cd_sweep`` —
+    same update order, residual held in VMEM across all coordinates.
+
+    ``X`` is ``(m, n)``, ``y`` ``(m,)``, ``theta`` ``(n,)``; returns the
+    updated ``(n,)`` theta.  Callers gate on :func:`sweep_mode`."""
+    m, n = X.shape
+    r0 = (y - X @ theta).reshape(m, 1)
+    Xp = pad_to(X, (8, LANE))
+    m_pad, n_pad = Xp.shape
+    r0p = pad_to(r0, (m_pad, 1))
+    thp = pad_to(theta.reshape(1, n), (1, n_pad))
+    lam_arr = jnp.full((1, 1), lam, dtype=X.dtype)
+    out = pl.pallas_call(
+        functools.partial(_sweep_kernel, m, n),
+        grid=(n_pad // LANE,),
+        in_specs=[
+            pl.BlockSpec((m_pad, LANE), lambda j: (0, j)),
+            pl.BlockSpec((1, LANE), lambda j: (0, j)),
+            pl.BlockSpec((m_pad, 1), lambda j: (0, 0)),
+            pl.BlockSpec((1, 1), lambda j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, LANE), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), X.dtype),
+        scratch_shapes=[pltpu.VMEM((m_pad, 1), jnp.float32)],
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4.0 * m_pad * n_pad,
+            # the fusion win: X read ONCE per sweep, the residual never
+            # leaves VMEM (classic re-streams it every coordinate)
+            bytes_accessed=(m_pad * n_pad + m_pad + 2 * n_pad)
+            * X.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(Xp, thp, r0p, lam_arr)
+    return out[0, :n]

@@ -16,36 +16,18 @@ for kernel-logic testing on CPU.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["matmul"]
+from ._pallas_common import mode as _mode
+from ._pallas_common import pad_to as _pad_to
+from ._pallas_common import sublane as _sublane
+from ._pallas_common import tpu_compiler_params
 
-
-def tpu_compiler_params(**kwargs):
-    """Pallas TPU compiler params across the API drift: the class is
-    ``CompilerParams`` on jax>=0.6.1 but ``TPUCompilerParams`` before —
-    the version-dispatch twin of ``collectives.shard_map_unchecked``."""
-    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
-    return cls(**kwargs)
-
-
-def _mode() -> str:
-    forced = os.environ.get("HEAT_TPU_PALLAS", "")
-    if forced in ("interpret", "tpu", "off"):
-        return forced
-    return "tpu" if jax.default_backend() == "tpu" else "off"
-
-
-def _pad_to(x: jax.Array, mults) -> jax.Array:
-    pads = [(0, (-d) % m) for d, m in zip(x.shape, mults)]
-    if any(p[1] for p in pads):
-        x = jnp.pad(x, pads)
-    return x
+__all__ = ["matmul", "tpu_compiler_params"]
 
 
 def _mm_kernel(a_ref, b_ref, o_ref, acc_ref):
@@ -68,7 +50,7 @@ def _mm_pallas(a, b, block_m=512, block_n=512, block_k=512, interpret=False):
     _, n = b.shape
     bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
     # MXU/VPU lane alignment (pallas_guide: min tile (8,128) f32 / (16,128) bf16)
-    sub = 16 if a.dtype == jnp.bfloat16 else 8
+    sub = _sublane(a.dtype)
     bm = max(sub, bm - bm % sub) if m >= sub else m
     bk = max(128, bk - bk % 128) if k >= 128 else k
     bn = max(128, bn - bn % 128) if n >= 128 else n

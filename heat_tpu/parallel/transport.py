@@ -59,6 +59,7 @@ asserted at mesh 4 and 8.
 
 from __future__ import annotations
 
+import functools
 import os
 from functools import lru_cache
 from typing import Optional, Sequence, Tuple
@@ -194,7 +195,8 @@ def _plan_tile_budget(kind: str) -> int:
     return got
 
 
-def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
+def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None,
+                      observer=None):
     """Run ``run(tile_bytes)`` with bounded OOM backoff: on a
     RESOURCE_EXHAUSTED failure the tile budget halves and the transfer
     retries, down to ``TILE_FLOOR_BYTES`` — a transient allocation squeeze
@@ -230,7 +232,7 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None):
         while True:
             try:
                 guard.fire(f"transport.{kind}")
-                out = telemetry.timed_call(fp, run, tb)
+                out = telemetry.timed_call(fp, run, tb, observer=observer)
             except Exception as err:  # noqa: BLE001 — filtered to OOM below
                 if not _is_oom(err):
                     raise
@@ -603,10 +605,13 @@ def _build_tiled_resplit_fused(
     full-shape leaf arrives in canonical source-split physical layout and
     is viewed as ``(pa, S, pb)`` exactly like the unfused engine's single
     operand, scalars broadcast per block.  The chain also runs on the
-    padding lanes and produces garbage there — source-axis pad rows are
-    sliced off after the loop and destination-axis pad columns are
-    re-zeroed, so the output keeps the clean zero-pad physical contract
-    (f(0) != 0 must not leak into the pad)."""
+    padding lanes and produces garbage there (``f(0) != 0``, or Inf/NaN
+    from e.g. ``1/x`` / ``log`` at zero).  Round 15 hardening: source-
+    axis pad rows are zeroed PER TILE before the ``all_to_all`` (garbage
+    — in particular non-finite values — never rides the wire or lands in
+    the accumulator), and destination-axis pad columns are re-zeroed
+    after the loop, so the output keeps the clean zero-pad physical
+    contract on both split axes."""
     S = int(mesh.shape[axis_name])
     pb = -(-n_b // S)
     padded_b = n_tiles * tile_cols
@@ -633,6 +638,17 @@ def _build_tiled_resplit_fused(
             pa = xr.shape[0]
             prepped.append(xr)
 
+        # source-axis pad-lane mask (transport hazard, round 15): the
+        # chain evaluated f on the physical pad rows of axis ``sa``;
+        # zero its output there before the collective so garbage never
+        # leaves the shard.  Slicing after the loop also removed it, but
+        # non-finite values would still have crossed the wire and sat in
+        # the accumulator slab.
+        src_keep = None
+        if S * pa != n_a:
+            rows = lax.axis_index(axis_name) * pa + jnp.arange(pa)
+            src_keep = (rows < n_a).reshape((pa, 1, 1) + (1,) * len(rest))
+
         def tile(t, acc):
             env = {}
             for s_i, ins in enumerate(instrs):
@@ -647,6 +663,8 @@ def _build_tiled_resplit_fused(
                     _, fn, kw, ch = ins
                     env[s_i] = fn(*(env[c] for c in ch), **dict(kw))
             blk = env[out_slot].astype(wire_dtype)
+            if src_keep is not None:
+                blk = jnp.where(src_keep, blk, jnp.zeros((), wire_dtype))
             got = lax.all_to_all(
                 blk, axis_name, split_axis=1, concat_axis=0, tiled=True
             )
@@ -892,7 +910,7 @@ def rechunk_plan(m_in, rowsz_in, m_out, rowsz_out, S):
     )
 
 
-def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk):
+def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, repack=""):
     """Flat rechunk: split-0 rows of ``shape_in[1:]`` → split-0 rows of
     ``shape_out[1:]`` following a host-computed :func:`rechunk_plan`.
 
@@ -903,7 +921,14 @@ def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk):
     beyond the tile budget stream through ``fori_loop`` chunks; the
     source slab is padded by one chunk so the final partial chunk's
     ``dynamic_slice`` never clamps (a clamped start would misalign the
-    valid head)."""
+    valid head).
+
+    ``repack`` (``""`` | ``"interpret"`` | ``"tpu"``) routes the final
+    local reshape through the lane-aware Pallas repack kernel
+    (``ops/repack.py``) — the narrow-minor ``kernel`` autotune arm that
+    writes the output at ~1x logical bytes instead of the padded
+    ~12.8x.  Bit-exact either way; the arm only changes physical
+    layout traffic."""
     S = int(mesh.shape[axis_name])
     pa = -(-shape_in[0] // S)
     pb = -(-shape_out[0] // S)
@@ -939,7 +964,14 @@ def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk):
                 acc = body(0, acc)
             else:
                 acc = lax.fori_loop(0, n_ch, body, acc)
-        return acc.reshape((pb,) + tuple(shape_out[1:]))
+        loc_shape = (pb,) + tuple(shape_out[1:])
+        if repack:
+            from ..ops import repack as _repack_kernel
+
+            return _repack_kernel.repack(
+                acc, loc_shape, interpret=(repack == "interpret")
+            )
+        return acc.reshape(loc_shape)
 
     return shard_map_unchecked(
         local,
@@ -950,8 +982,8 @@ def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk):
 
 
 @lru_cache(maxsize=512)
-def _jit_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, donate):
-    fn = _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk)
+def _jit_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, donate, repack=""):
+    fn = _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, repack)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
@@ -1050,18 +1082,34 @@ def tiled_reshape(
         raise ValueError("rechunk plan out of shift budget")
     itemsize = max(int(jnp.dtype(phys.dtype).itemsize), 1)
 
-    def run_rechunk(tb, phys=phys):
-        chunk = max(1, tb // itemsize)
-        fn = _jit_rechunk(
-            comm.mesh, comm.split_axis, gin, gout, plan, chunk, mid_owned
-        )
-        return fn(phys)
+    def _mk_run(repack_arm, donate_arg, phys=phys):
+        def run(tb):
+            chunk = max(1, tb // itemsize)
+            fn = _jit_rechunk(
+                comm.mesh, comm.split_axis, gin, gout, plan, chunk,
+                donate_arg, repack_arm,
+            )
+            return fn(phys)
 
-    fp = None
+        return run
+
+    # narrow-minor kernel arm (ops/repack.py): eligible when the local
+    # output block has a < 128-lane minor dim and the Pallas tier is
+    # live; dispatched per fingerprint by the autotune table, measured
+    # against the classic lowering.  Safe decline: any ineligibility
+    # (layout, backend, kill switch, autotune off) keeps the classic
+    # path byte-for-byte, with no table entry created.
+    from ..ops import repack as _repack
+
+    pb_out = -(-gout[0] // S)
+    loc_out_shape = (pb_out,) + gout[1:]
+    kmode = _repack.repack_mode(loc_out_shape, phys.dtype)
+
+    nelem = 1
+    for d in gin:
+        nelem *= d
+    fp = fp_k = None
     if telemetry.ledger_enabled():
-        nelem = 1
-        for d in gin:
-            nelem *= d
         fp = telemetry.fingerprint(
             ("reshape", gin, int(si), gout, int(so), S, str(phys.dtype)),
         )
@@ -1070,7 +1118,69 @@ def tiled_reshape(
             hbm_bytes=2.0 * nelem * itemsize, mesh={"devices": S},
             dtype=str(phys.dtype),
         )
-    phys = _with_oom_backoff("reshape", run_rechunk, tile_bytes, fp=fp)
+        if kmode != "off":
+            # separate ledger row per arm: the roofline report must
+            # attribute the repack win (same logical bytes, higher
+            # achieved fraction) instead of averaging it into the
+            # classic row
+            fp_k = telemetry.fingerprint(
+                ("reshape_repack", gin, int(si), gout, int(so), S,
+                 str(phys.dtype)),
+            )
+            telemetry.ensure_program(
+                fp_k, kind="kernel_repack", ops=1, flops=0.0,
+                hbm_bytes=2.0 * nelem * itemsize, mesh={"devices": S},
+                dtype=str(phys.dtype),
+            )
+
+    arm = "classic"
+    key = None
+    if kmode != "off" and autotune.enabled():
+        key = autotune.kernel_key(
+            "reshape_repack", gin, int(si), gout, int(so), S,
+            str(phys.dtype),
+        )
+        d = autotune.decide(
+            key, "classic",
+            desc=f"reshape {gin}->{gout} minor={gout[-1]}",
+            arms=autotune.KERNEL_ARMS,
+        )
+        if d.explore:
+            # run BOTH arms under measurement; donation suppressed (the
+            # same source buffer feeds both runs).  The classic result
+            # is returned, so numerics never depend on tuning state
+            # (repack is bit-exact anyway — this keeps the invariant
+            # uniform across kernel sites).
+            out_c, t_c = autotune.timed(
+                lambda: _with_oom_backoff(
+                    "reshape", _mk_run("", False), tile_bytes, fp=fp
+                )
+            )
+            out_k, t_k = autotune.timed(
+                lambda: _with_oom_backoff(
+                    "reshape", _mk_run(kmode, False), tile_bytes, fp=fp_k
+                )
+            )
+            autotune.observe(key, "classic", t_c)
+            autotune.observe(key, "kernel", t_k)
+            memtrack.register_buffer(out_k, tag="staging", split=0)
+            phys = out_c
+            arm = "explore"
+        elif d.arm == "kernel":
+            arm = "kernel"
+    if arm == "kernel":
+        # steady state: the sampled observer keeps the degradation watch
+        # alive — a kernel winner gone >2x slower than its recorded best
+        # is sent back to explore (same guard as the ring matmul's)
+        phys = _with_oom_backoff(
+            "reshape", _mk_run(kmode, mid_owned), tile_bytes, fp=fp_k,
+            observer=functools.partial(autotune.observe, key, "kernel"),
+        )
+        memtrack.register_buffer(phys, tag="output", split=0)
+    elif arm == "classic":
+        phys = _with_oom_backoff(
+            "reshape", _mk_run("", mid_owned), tile_bytes, fp=fp
+        )
 
     if so != 0:
         phys = tiled_resplit(phys, gout, 0, so, comm, donate=True,

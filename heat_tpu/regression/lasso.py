@@ -15,7 +15,8 @@ import jax.numpy as jnp
 
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray, _ensure_split
-from ..core import telemetry, types
+from ..core import autotune, telemetry, types
+from ..ops import lasso_sweep
 
 __all__ = ["Lasso"]
 
@@ -48,12 +49,19 @@ def _cd_sweep(X, y, theta, lam):
     return theta
 
 
-@jax.jit
-def _cd_fit(X, y, theta, lam, max_iter, tol):
+@partial(jax.jit, static_argnames=("kernel",))
+def _cd_fit(X, y, theta, lam, max_iter, tol, kernel: str = ""):
     """Coordinate-descent sweeps until ``max |Δθ| < tol`` or ``max_iter``,
     entirely on-device: per-sweep host readbacks of the convergence scalar
     cost ~100x a sweep's compute through a remote TPU tunnel (same pattern
-    as cluster._kcluster._median_loop)."""
+    as cluster._kcluster._median_loop).
+
+    ``kernel`` (``""``/``"tpu"``/``"interpret"``, static) routes each
+    sweep through the fused Pallas kernel (``ops/lasso_sweep.py``) —
+    residual resident in VMEM across all coordinates — instead of the
+    XLA ``fori_loop`` lowering.  Callers gate on
+    ``lasso_sweep.sweep_mode``; the autotune ``kernel`` arm in
+    :meth:`Lasso.fit` measures it against the classic sweep."""
 
     def cond(state):
         _, diff, it = state
@@ -61,7 +69,12 @@ def _cd_fit(X, y, theta, lam, max_iter, tol):
 
     def body(state):
         th, _, it = state
-        new = _cd_sweep(X, y, th, lam)
+        if kernel:
+            new = lasso_sweep.sweep(
+                X, y, th, lam, interpret=(kernel == "interpret")
+            )
+        else:
+            new = _cd_sweep(X, y, th, lam)
         return new, jnp.max(jnp.abs(new - th)), it + 1
 
     init = (theta, jnp.array(jnp.inf, X.dtype), 0)
@@ -130,10 +143,50 @@ class Lasso(RegressionMixin, BaseEstimator):
         ones = jnp.ones((X.shape[0], 1), dtype=X.dtype)
         Xa = jnp.concatenate([ones, X], axis=1)
 
-        theta = jnp.zeros(Xa.shape[1], dtype=X.dtype)
-        theta, _, n_iter = _cd_fit(
-            Xa, yv, theta, self.__lam, self.max_iter, self.tol
-        )
+        theta0 = jnp.zeros(Xa.shape[1], dtype=X.dtype)
+        ma, na = Xa.shape
+
+        def fit_fn(km: str = ""):
+            return _cd_fit(
+                Xa, yv, theta0, self.__lam, self.max_iter, self.tol,
+                kernel=km,
+            )
+
+        # round 15: the fused VMEM-resident sweep as a measured autotune
+        # arm — explore times BOTH lowerings (returning the classic
+        # result so coefficients never depend on tuning state), then the
+        # per-geometry winner sticks with a degradation watch
+        kmode = lasso_sweep.sweep_mode(ma, na, Xa.dtype, x.split, x.comm.size)
+        if kmode != "off" and autotune.enabled():
+            dt = str(Xa.dtype)
+            fp_k = telemetry.fingerprint(("lasso_sweep_fused", ma, na, dt))
+            telemetry.ensure_program(
+                fp_k, kind="kernel_lasso_sweep", ops=1,
+                flops=4.0 * ma * na,
+                hbm_bytes=float(ma * na * Xa.dtype.itemsize),
+                mesh={"devices": x.comm.size}, dtype=dt,
+            )
+            key = autotune.kernel_key("lasso_sweep", ma, na, dt, x.comm.size)
+            d = autotune.decide(
+                key, "classic", desc=f"lasso {ma}x{na} {dt}",
+                arms=autotune.KERNEL_ARMS,
+            )
+            if d.explore:
+                out_c, t_c = autotune.timed(fit_fn)
+                _, t_k = autotune.timed(fit_fn, kmode)
+                autotune.observe(key, "classic", t_c)
+                autotune.observe(key, "kernel", t_k)
+                telemetry.record_timing(fp_k, t_k)
+                theta, _, n_iter = out_c
+            elif d.arm == "kernel":
+                theta, _, n_iter = telemetry.timed_call(
+                    fp_k, fit_fn, kmode,
+                    observer=partial(autotune.observe, key, "kernel"),
+                )
+            else:
+                theta, _, n_iter = fit_fn()
+        else:
+            theta, _, n_iter = fit_fn()
         self.n_iter = int(n_iter)
 
         self.__theta = DNDarray(

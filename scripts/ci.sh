@@ -63,6 +63,14 @@
 #                 it via HEAT_TPU_AUTOTUNE_CACHE and must do zero
 #                 explores — and the perf-regression gate rerun with the
 #                 tuning plane on
+#  16. kernels   — Pallas kernel tier (ISSUE 12): the kernel test file at
+#                 meshes 8/4/1 (repack/qr-panel/lasso-sweep correctness in
+#                 interpret mode, autotune arm registration, kill
+#                 switches, off-mode bit-for-bit equivalence), the cb
+#                 kernels suite end-to-end — its three rows must land
+#                 with an honest measured-arm field and its Prometheus
+#                 export must parse — and the perf-regression gate rerun
+#                 with the kernel arms enabled
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -75,7 +83,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/15 suite (8-device mesh)"
+say "1/16 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -84,21 +92,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/15 core subset (4-device mesh)"
+say "2/16 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/15 parity audit (exits nonzero on any gap)"
+say "3/16 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/15 multi-chip dry-run"
+say "4/16 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/15 cb smoke"
+say "5/16 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -107,10 +115,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/15 copycheck"
+say "6/16 copycheck"
 python scripts/copycheck.py
 
-say "7/15 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/16 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -126,10 +134,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/15 fusion retrace guard (second call must hit the compile cache)"
+say "8/16 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/15 guardrails (fault injection + strict-guard retrace check)"
+say "9/16 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -140,7 +148,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/15 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/16 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -148,13 +156,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/15 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/16 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/15 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/16 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -185,7 +193,7 @@ for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/15 roofline attribution + perf-regression gate"
+say "13/16 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -234,7 +242,7 @@ print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
-say "14/15 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+say "14/16 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
 # the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
 # live end-to-end forensics check: census-bearing postmortem, informed
 # first retry from measured free HBM, and the memory counter track
@@ -299,7 +307,7 @@ print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
       f"bytes, {len(counters)} counter samples")
 EOF
 
-say "15/15 autotune (explore/exploit laws + live two-process warm start)"
+say "15/16 autotune (explore/exploit laws + live two-process warm start)"
 # the self-tuning-runtime contracts (ISSUE 11) at three mesh sizes, then a
 # live warm-start check: process 1 explores, resolves winners and saves its
 # table; process 2 loads the cache at import and must do ZERO explores —
@@ -381,6 +389,56 @@ assert reg["rows"], "check-regression attached an empty delta table"
 assert not reg["regressions"], \
     f"regressions with autotuning on: {reg['regressions']}"
 print(f"autotuned check-regression OK: {len(reg['rows'])} rows judged")
+EOF
+
+say "16/16 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
+# the kernel-tier contracts (ISSUE 12) at three mesh sizes: each test
+# scopes HEAT_TPU_PALLAS=interpret itself, so plain pytest runs suffice —
+# repack bit-exactness (incl. the pad-lane regression), fused QR panel vs
+# the classic three-launch chain (incl. NaN breakdown parity), fused lasso
+# sweep vs the classic sweep, explore-then-stick dispatch, kill switches,
+# and HEAT_TPU_AUTOTUNE=off bit-for-bit equivalence
+python -m pytest -q -p no:cacheprovider \
+  tests/test_kernels.py 2>&1 | tee /tmp/ci_kernels.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_kernels.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_kernels.py
+# the cb kernels suite end-to-end: three rows through the
+# autotune-dispatched surfaces (never calling kernels directly), the
+# measured arm recorded per row (honest "classic" + decline note off
+# TPU), the regression gate green with the kernel arms enabled, and the
+# telemetry export still well-formed with kernel-tier programs in it
+( cd benchmarks/cb && \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events \
+  python main.py --only kernels --check-regression \
+  --out /tmp/ci_cb_kernels.json --prom /tmp/ci_cb_kernels.prom )
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/ci_cb_kernels.json"))
+rows = {m["name"]: m for m in doc["measurements"]}
+for want in ("reshape_repack", "qr_panel_fused", "lasso_sweep_fused"):
+    assert want in rows, f"cb kernels suite missing row {want}"
+    row = rows[want]
+    assert row.get("arm") in ("classic", "kernel"), \
+        f"{want} lacks a measured arm field: {row.get('arm')!r}"
+    assert row.get("note"), f"{want} lacks its bound/arm note"
+reg = doc["regression"]
+assert reg["rows"], "check-regression attached an empty delta table"
+assert not reg["regressions"], \
+    f"kernel-arm regressions: {reg['regressions']}"
+lines = open("/tmp/ci_cb_kernels.prom").read().splitlines()
+typed = {l.split()[2] for l in lines if l.startswith("# TYPE ")}
+samples = [l for l in lines if l and not l.startswith("#")]
+assert samples, "empty Prometheus export from the kernels run"
+for l in samples:
+    name, value = l.rsplit(" ", 1)
+    assert name.split("{", 1)[0] in typed, f"untyped sample {name}"
+    float(value)
+arms = {rows[n]["arm"] for n in rows}
+print(f"cb kernels OK: {len(rows)} rows (arms={sorted(arms)}), "
+      f"{len(reg['rows'])} judged, {len(samples)} gauges")
 EOF
 
 say "CI GREEN"
