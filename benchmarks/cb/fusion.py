@@ -23,6 +23,11 @@
 #  * autotune_overhead — the self-tuning decision layer (ISSUE 11): an
 #    already-tuned matmul fingerprint in auto mode (table consult per
 #    call) vs the same schedule pinned statically (<2% is the bar).
+#  * analysis_overhead — the SPMD hazard analyzer (ISSUE 16): the same
+#    consumed chain with the runtime sanitizer + program auditor live vs
+#    both off (<2% is the bar; the steady-state footprint is the fusion
+#    funnel's per-leaf poison probe — the program walk is once per
+#    fingerprint, off the hit path by construction).
 #
 # ``python fusion.py --verify-cache`` is the CI retrace guard: it runs each
 # benchmark chain twice and fails (exit 1) if the second invocation reports
@@ -38,6 +43,8 @@ import time
 import jax
 
 import heat_tpu as ht
+from heat_tpu.analysis import program_audit as ht_program_audit
+from heat_tpu.analysis import sanitize as ht_sanitize
 from heat_tpu.core import autotune as ht_autotune
 from heat_tpu.core import fusion as ht_fusion
 from heat_tpu.core import guard as ht_guard
@@ -231,6 +238,54 @@ def run():
              "flight-recorder base both arms share. Median of 41 "
              "interleaved pair ratios, arm order alternating. Acceptance "
              "bar is overhead_frac < 0.02.",
+    )
+
+    # analysis_overhead: the ISSUE-16 hazard analyzer — the same consumed
+    # chain with the runtime sanitizer AND the program auditor live vs
+    # both off.  The steady-state footprint is the fusion funnel's
+    # check_use per DAG leaf (a dict probe each) behind one enabled()
+    # gate; the auditor's program walk is once per fingerprint, so the
+    # cached hit path this row measures never re-audits.  Both arms at
+    # events level; interleaved pairs with alternating order, same as
+    # memtrack_overhead and for the same reason.  The counter delta
+    # proves the measured arm actually ran the sanitizer funnel.
+    with ht_telemetry.telemetry_level("events"):
+        run_consume(1)
+        sz0 = ht_telemetry.snapshot_group("sanitize")
+        pair_ratios, on_slopes, off_slopes = [], [], []
+        for i in range(41):
+            arms = ("on", "off") if i % 2 == 0 else ("off", "on")
+            got = {}
+            for arm in arms:
+                prev_sz = ht_sanitize.set_enabled(arm == "on")
+                prev_am = ht_program_audit.set_mode(
+                    "jaxpr" if arm == "on" else "off"
+                )
+                try:
+                    got[arm] = _delta_mt()
+                finally:
+                    ht_sanitize.set_enabled(prev_sz)
+                    ht_program_audit.set_mode(prev_am)
+            pair_ratios.append(got["on"] / got["off"])
+            on_slopes.append(got["on"])
+            off_slopes.append(got["off"])
+        sz1 = ht_telemetry.snapshot_group("sanitize")
+    pair_ratios.sort()
+    on_slopes.sort()
+    off_slopes.sort()
+    mid = len(pair_ratios) // 2
+    record(
+        "analysis_overhead", on_slopes[mid], per="6-op-chain",
+        n=CHAIN_N, analyzer_off_per_unit_s=round(off_slopes[mid], 6),
+        overhead_frac=round(pair_ratios[mid] - 1.0, 4),
+        sanitizer_checks=int(sz1["checks"] - sz0["checks"]),
+        method="interleaved-chain-delta", k1=1, k2=33, pairs=41,
+        note="SPMD hazard analyzer tax, sanitizer+auditor on vs off on "
+             "the consumed fused chain: per-materialization poison "
+             "probes on every DAG leaf plus the audit/sanitize enable "
+             "gates; the program audit itself amortizes to zero on the "
+             "cached hit path. Median of 41 interleaved pair ratios, arm "
+             "order alternating. Acceptance bar is overhead_frac < 0.02.",
     )
 
     # autotune_overhead: the ISSUE-11 decision layer.  On an already-tuned

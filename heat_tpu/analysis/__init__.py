@@ -1,0 +1,22 @@
+"""SPMD hazard analyzer: three tiers against the bug classes that are
+catastrophic at mesh scale.
+
+* :mod:`heat_tpu.analysis.lint` — AST rules HT001–HT005
+  (``python -m heat_tpu.analysis --check``): raw env parses, unmeasured
+  host syncs, rank-divergent branches gating collectives, orphan counter
+  dicts, static use-after-donate.
+* :mod:`heat_tpu.analysis.program_audit` — compiled-program auditor
+  (``HEAT_TPU_AUDIT=1`` / ``hlo``) at the fusion/transport/overlap
+  compile sites: donation-aliasing violations, host callbacks,
+  unmodeled collectives; findings land as ``analysis_finding`` events
+  and mark roofline rows audited-dirty.
+* :mod:`heat_tpu.analysis.sanitize` — runtime sanitizer
+  (``HEAT_TPU_SANITIZE=1``): donated-buffer poisoning (use-after-donate
+  raises with the creation site) and the per-process collective-sequence
+  fingerprint (the SPMD lockstep law).
+"""
+
+from . import lint, program_audit, sanitize
+from .sanitize import UseAfterDonateError
+
+__all__ = ["lint", "program_audit", "sanitize", "UseAfterDonateError"]

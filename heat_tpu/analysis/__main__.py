@@ -1,0 +1,8 @@
+"""``python -m heat_tpu.analysis`` — the lint CLI (see lint.main)."""
+
+import sys
+
+from .lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

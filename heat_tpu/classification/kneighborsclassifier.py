@@ -39,7 +39,7 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
         from ..core import factories, statistics
 
         labels = x.larray.reshape(-1).astype("int32")
-        n_features = int(statistics.max(x).item()) + 1
+        n_features = int(statistics.max(x).item()) + 1  # ht: HT002 ok — one scalar readback fixes the one-hot width at fit
         encoded = jax.nn.one_hot(labels, n_features, dtype="float32")
         out = factories.array(encoded, split=x.split, device=x.device, comm=x.comm)
         return out

@@ -232,7 +232,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         if return_inertia:
             inertia = statistics.min(distances, axis=1).sum()
             fusion.materialize(labels, inertia)
-            inertia_val = float(jnp.asarray(inertia.larray).reshape(()))
+            inertia_val = float(jnp.asarray(inertia.larray).reshape(()))  # ht: HT002 ok — end-of-fit inertia readback, one scalar per fit
         if labels.split != x.split:
             out = DNDarray(
                 labels.larray, labels.gshape, labels.dtype, x.split, x.device, x.comm
@@ -265,7 +265,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             arr, centers, self.n_clusters, self.max_iter, self.tol,
             snap_to_sample=snap_to_sample,
         )
-        self._n_iter = int(n_iter)
+        self._n_iter = int(n_iter)  # ht: HT002 ok — end-of-fit n_iter readback, one scalar per fit
         self._cluster_centers = DNDarray(
             centers, tuple(centers.shape),
             types.canonical_heat_type(centers.dtype), None, x.device, x.comm,

@@ -482,14 +482,14 @@ class KMeans(_KCluster):
             centers, _, inertia, n_iter = _lloyd_loop(
                 arr, centers, self.n_clusters, self.max_iter, self.tol
             )
-        self._n_iter = int(n_iter)
+        self._n_iter = int(n_iter)  # ht: HT002 ok — end-of-fit n_iter readback, one scalar per fit
 
         self._cluster_centers = DNDarray(
             centers, tuple(centers.shape), types.canonical_heat_type(centers.dtype),
             None, x.device, x.comm,
         )
         self._labels = self._assign_to_cluster(x)
-        self._inertia = float(inertia)
+        self._inertia = float(inertia)  # ht: HT002 ok — end-of-fit inertia readback, one scalar per fit
         return self
 
     # ------------------------------------------------------ packed-ingest path
@@ -556,7 +556,7 @@ class KMeans(_KCluster):
                 self.n_clusters, packed.p, self.max_iter, self.tol,
                 with_inertia=False,
             )
-        self._n_iter = int(n_iter)
+        self._n_iter = int(n_iter)  # ht: HT002 ok — end-of-fit n_iter readback, one scalar per fit
         self._cluster_centers = DNDarray(
             centers, tuple(centers.shape),
             types.canonical_heat_type(centers.dtype), None, packed.device,
@@ -569,7 +569,7 @@ class KMeans(_KCluster):
         # iteration's assignment distances, pre-update centers.)
         del inertia
         self._labels, inertia = self._predict_packed(packed, with_inertia=True)
-        self._inertia = float(inertia)
+        self._inertia = float(inertia)  # ht: HT002 ok — end-of-fit inertia readback, one scalar per fit
         return self
 
     def _predict_packed(self, packed, with_inertia: bool = False):

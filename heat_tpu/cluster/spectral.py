@@ -93,7 +93,7 @@ class Spectral(ClusteringMixin, BaseEstimator):
         if self.n_clusters is None:
             # largest eigen-gap heuristic (reference: spectral.py:166)
             gaps = jnp.diff(evals)
-            self.n_clusters = int(jnp.argmax(gaps)) + 1
+            self.n_clusters = int(jnp.argmax(gaps)) + 1  # ht: HT002 ok — eigen-gap model selection needs the host-side cluster count
             self._cluster.n_clusters = self.n_clusters
 
         components = evecs[:, : self.n_clusters]

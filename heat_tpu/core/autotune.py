@@ -50,6 +50,7 @@ import time
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 from . import memtrack, telemetry
+from .envparse import env_int  # the strict env-int twin of env_bytes (lint HT001)
 from .version import __version__
 
 __all__ = [
@@ -61,6 +62,7 @@ __all__ = [
     "device_kind",
     "enabled",
     "env_bytes",
+    "env_int",
     "explore_k",
     "kernel_key",
     "load",
@@ -439,7 +441,7 @@ def timed(fn: Callable, *args) -> Tuple[Any, float]:
     try:
         import jax
 
-        jax.block_until_ready(out)
+        jax.block_until_ready(out)  # ht: HT002 ok — this IS the measured-arm timing barrier (autotune.timed)
     except Exception:
         pass
     return out, time.perf_counter() - t0

@@ -88,7 +88,7 @@ class ClassificationMixin:
     def score(self, x: DNDarray, y: DNDarray, sample_weight=None) -> float:
         """Mean accuracy of ``predict(x)`` vs ``y``."""
         pred = self.predict(x)
-        return float((pred.larray.reshape(-1) == y.larray.reshape(-1)).mean())
+        return float((pred.larray.reshape(-1) == y.larray.reshape(-1)).mean())  # ht: HT002 ok — user-facing scalar metric API; the sync IS the contract
 
 
 class ClusteringMixin:
@@ -123,7 +123,7 @@ class RegressionMixin:
         yv = y.larray.reshape(-1)
         ss_res = jnp.sum((yv - pred) ** 2)
         ss_tot = jnp.sum((yv - jnp.mean(yv)) ** 2)
-        return float(1.0 - ss_res / ss_tot)
+        return float(1.0 - ss_res / ss_tot)  # ht: HT002 ok — user-facing scalar metric API; the sync IS the contract
 
 
 class TransformMixin:

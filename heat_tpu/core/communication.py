@@ -52,7 +52,7 @@ class MPIRequest:
 
     def wait(self):
         if self.value is not None:
-            jax.block_until_ready(self.value)
+            jax.block_until_ready(self.value)  # ht: HT002 ok — MPIRequest.wait() compat: blocking is the documented semantic
         return self.value
 
     Wait = wait

@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from . import devices, memtrack, types
 from .devices import Device
+from ..analysis import sanitize
 from ..parallel import transport
 from ..parallel.mesh import MeshComm, sanitize_comm
 from .stride_tricks import sanitize_axis
@@ -410,7 +411,7 @@ class DNDarray:
         """The single element of a size-1 array (reference: dndarray.py:1097)."""
         if self.size != 1:
             raise ValueError("only one-element arrays can be converted to Python scalars")
-        return self.larray.reshape(()).item()
+        return self.larray.reshape(()).item()  # ht: HT002 ok — scalar-conversion protocol (__int__ et al) requires the host value
 
     def __bool__(self) -> bool:
         return bool(self.__cast(bool))
@@ -429,7 +430,7 @@ class DNDarray:
         — a Bcast there; a host read here)."""
         if self.size != 1:
             raise TypeError("only size-1 arrays can be converted to Python scalars")
-        return cast_function(self.larray.reshape(()).item())
+        return cast_function(self.larray.reshape(()).item())  # ht: HT002 ok — scalar cast protocol requires the host value
 
     # ----------------------------------------------------------- distribution
     def is_distributed(self) -> bool:
@@ -484,10 +485,17 @@ class DNDarray:
                 donate = safe_to_donate(self.__array)
                 if donate:
                     memtrack.tag_buffer(self.__array, "donated")
+                old = self.__array
                 self.__array = transport.tiled_resplit(
                     self.__array, self.__gshape, self.__split, axis, self.__comm,
                     donate=donate,
                 )
+                if donate:
+                    # the old physical buffer now belongs to XLA — poison
+                    # it so a stale raw-array handle raises with this site
+                    sanitize.poison(
+                        old, donated_site="DNDarray.resplit_(donate)"
+                    )
                 memtrack.register_buffer(self.__array, tag="output", split=axis)
         else:
             self.__array = _to_physical(self.larray, self.__gshape, axis, self.__comm)
@@ -1022,7 +1030,7 @@ class DNDarray:
         m_log = m_log.astype(jnp.bool_)
         # phase 1: the count — ONE scalar readback fixes the static output
         # extent (the reference pays the same sync in its count Allgather)
-        n_sel = int(jnp.sum(m_log))
+        n_sel = int(jnp.sum(m_log))  # ht: HT002 ok — documented one-scalar sync fixing the static output extent
         if flatten:
             gshape, out_split = (n_sel,), 0
             n_axis = int(np.prod(self.__gshape))

@@ -271,7 +271,7 @@ def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, orde
     """Constant-filled array (reference: factories.py:946)."""
     if dtype is None:
         dtype = types.float32  # reference default (factories.py:946)
-    value = fill_value.item() if hasattr(fill_value, "item") else fill_value
+    value = fill_value.item() if hasattr(fill_value, "item") else fill_value  # ht: HT002 ok — fill_value is a caller-supplied host scalar, not an engine value
     return __factory(shape, dtype, split, "full", device, comm, fill_value=value)
 
 

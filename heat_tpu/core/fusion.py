@@ -61,7 +61,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import guard, memtrack, telemetry, types
+from . import envparse, guard, memtrack, telemetry, types
+from ..analysis import program_audit, sanitize
 from .dndarray import DNDarray, _physical_dim
 from .guard import NonFiniteError
 
@@ -601,7 +602,7 @@ class _Entry:
 
 
 _CACHE: "OrderedDict[tuple, _Entry]" = OrderedDict()
-_CACHE_MAX = int(os.environ.get("HEAT_TPU_FUSE_CACHE_SIZE", "4096"))
+_CACHE_MAX = envparse.env_int("HEAT_TPU_FUSE_CACHE_SIZE", 4096)
 # All counters live in ONE telemetry group; the registry owns the reset
 # contract (a counter added to the defaults below resets/exports/snapshots
 # with no second bookkeeping site).  Notable members:
@@ -987,6 +988,11 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
     forcing a second dispatch."""
     instrs, sites, leaves, out_slots = _linearize(*exprs)
     vals = [lf.value for lf in leaves]
+    if sanitize.enabled():
+        # every DAG leaf funnels through here — the use-after-donate
+        # choke point for fused programs
+        for v in vals:
+            sanitize.check_use(v, "fusion.materialize")
     lshapes = tuple(tuple(lf.lshape) for lf in leaves)
     gshapes = tuple(tuple(g) for g in gshapes)
     splits = tuple(splits)
@@ -1015,6 +1021,7 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
         guard_on, _terminator_salt(), _cache_salt(),
     )
     flag = None
+    donated_ran = False
     entry = _CACHE.get(key)
     if entry is None:
         _STATS["misses"] += 1
@@ -1062,6 +1069,17 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
                     targets, with_guard=fold,
                 )
             jitted = jax.jit(program, donate_argnums=donate or ())
+            if program_audit.enabled():
+                fp_a = fp
+                if fp_a is None:
+                    try:
+                        fp_a = _program_fingerprint(instrs, out_slots)
+                    except Exception:
+                        fp_a = None
+                program_audit.audit_program(
+                    "fused", fp_a, jitted, vals,
+                    donate=tuple(donate or ()), expect="reduce",
+                )
             # only mesh shardings are recorded for AOT re-lowering (last_hlo):
             # a SingleDeviceSharding on an uncommitted scalar leaf would pin it
             # to device 0 and clash with the mesh-committed array leaves
@@ -1075,6 +1093,7 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
             )
             entry = _Entry(jitted, avals)
             outs = entry.jitted(*vals)
+            donated_ran = True
             if fold:
                 outs, flag = outs[:-1], outs[-1]
         except Exception:
@@ -1119,6 +1138,7 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
             # clock; the miss path's first run is excluded — its wall is
             # trace+compile time, already on the compile_end event
             outs = telemetry.timed_call(entry.fp, entry.jitted, *vals)
+            donated_ran = True
             if fold:
                 outs, flag = outs[:-1], outs[-1]
         except Exception:
@@ -1127,6 +1147,14 @@ def _run_many_impl(exprs, gshapes, splits, comm, donate: Tuple[int, ...] = ()):
             outs = _eager_fallback(
                 instrs, vals, lshapes, out_slots, gshapes, splits, comm, targets
             )
+    if donate and donated_ran:
+        # the executed program consumed these leaves via donate_argnums —
+        # poison the stale handles (the eager fallback never donates)
+        for i in donate:
+            if i < len(vals):
+                sanitize.poison(
+                    vals[i], donated_site="fusion._run_many(donate_argnums)"
+                )
     outs = tuple(outs)
     fused_outs = outs
     outs = guard.corrupt("fusion.exec", outs)

@@ -374,7 +374,7 @@ def qr(
         # compiled program and its HBM high-water mark (the 4 GB head room
         # matters: see the 1e5x1e4 OOM margin in the commit history).
         # "defer" skips the sync; breakdown stays NaN-latched in Q/R.
-        if check == "defer" or bool(jnp.all(jnp.isfinite(r))):
+        if check == "defer" or bool(jnp.all(jnp.isfinite(r))):  # ht: HT002 ok — documented breakdown check; check='defer' skips it
             # chol succeeded; diagonal is positive by construction, no sign
             # pass needed
             r_ht = DNDarray(

@@ -35,7 +35,7 @@ def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = F
     """Global closeness verdict (reference: logical.py:~100)."""
     a = x.larray if isinstance(x, DNDarray) else jnp.asarray(x)
     b = y.larray if isinstance(y, DNDarray) else jnp.asarray(y)
-    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))  # ht: HT002 ok — allclose returns a Python bool by NumPy-parity contract
 
 
 def any(x, axis=None, out=None, keepdims=False) -> DNDarray:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from . import factories, fusion, sanitation, stride_tricks, types
 from .dndarray import DNDarray, _ensure_split, _to_physical
+from ..analysis import sanitize as spmd_sanitize
 from ..parallel import transport
 
 __all__ = [
@@ -350,6 +351,10 @@ def reshape(a: DNDarray, *shape, new_split=None) -> DNDarray:
                         phys = transport.tiled_reshape(
                             fused0, a.shape, 0, gout, ns, a.comm, donate=True
                         )
+                        spmd_sanitize.poison(
+                            fused0,
+                            donated_site="manipulations.reshape(stage0)",
+                        )
             if phys is None:
                 phys = transport.tiled_reshape(
                     a.parray, a.shape, a.split, gout, ns, a.comm
@@ -510,7 +515,7 @@ def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
     if isinstance(indices_or_sections, (list, tuple, np.ndarray)):
         parts = jnp.split(x.larray, np.asarray(indices_or_sections), axis=axis)
     else:
-        parts = jnp.split(x.larray, int(indices_or_sections), axis=axis)
+        parts = jnp.split(x.larray, int(indices_or_sections), axis=axis)  # ht: HT002 ok — indices_or_sections is a caller-supplied host argument
     split_ = None if axis == x.split else x.split
     return [_wrap(p, x, split_) for p in parts]
 
@@ -685,7 +690,7 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         shards = _split_axis_shards(compacted, 0)
         parts = []
         for r, sh in enumerate(shards):
-            c = int(counts_host[r])
+            c = int(counts_host[r])  # ht: HT002 ok — per-shard counts already fetched to host above
             if c:
                 # slice ON DEVICE before the transfer: np.asarray of the
                 # whole slab would move the full padded buffer to host —

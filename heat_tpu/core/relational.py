@@ -21,11 +21,11 @@ def equal(x, y) -> bool:
     if isinstance(x, DNDarray) and isinstance(y, DNDarray):
         if tuple(x.shape) != tuple(y.shape):
             return False
-        return bool(jnp.all(x.larray == y.larray))
+        return bool(jnp.all(x.larray == y.larray))  # ht: HT002 ok — equal() returns a Python bool by NumPy-parity contract
     a = x.larray if isinstance(x, DNDarray) else x
     b = y.larray if isinstance(y, DNDarray) else y
     try:
-        return bool(jnp.all(jnp.equal(a, b)))
+        return bool(jnp.all(jnp.equal(a, b)))  # ht: HT002 ok — equal() returns a Python bool by NumPy-parity contract
     except (ValueError, TypeError):
         return False
 

@@ -179,8 +179,8 @@ def histc(input, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) 
     sanitation.sanitize_in(input)
     lo, hi = float(min), float(max)
     if lo == 0.0 and hi == 0.0:
-        lo = float(jnp.min(input.larray))
-        hi = float(jnp.max(input.larray))
+        lo = float(jnp.min(input.larray))  # ht: HT002 ok — histogram range needs host bounds (NumPy parity)
+        hi = float(jnp.max(input.larray))  # ht: HT002 ok — histogram range needs host bounds (NumPy parity)
     hist, _ = jnp.histogram(input.larray, bins=bins, range=(lo, hi))
     hist = hist.astype(input.dtype.jax_type())
     wrapped = DNDarray(hist, tuple(hist.shape), input.dtype, None, input.device, input.comm)

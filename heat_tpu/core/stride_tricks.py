@@ -95,7 +95,7 @@ def sanitize_shape(shape, lval: int = 0) -> Tuple[int, ...]:
     out = []
     for dim in shape:
         if isinstance(dim, (np.ndarray,)) and dim.ndim == 0:
-            dim = dim.item()
+            dim = dim.item()  # ht: HT002 ok — 0-d numpy host array, not a device value
         if not isinstance(dim, (int, np.integer)):
             # accept 0-d jax arrays / things with __index__
             try:

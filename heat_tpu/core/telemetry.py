@@ -80,6 +80,8 @@ from collections import OrderedDict, deque
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional
 
+from . import envparse
+
 __all__ = [
     "autotune_report",
     "current_span",
@@ -393,12 +395,7 @@ def export_prometheus() -> str:
 # ----------------------------------------------------------- flight recorder
 
 def _env_capacity() -> int:
-    raw = os.environ.get("HEAT_TPU_TELEMETRY_CAPACITY", "").strip()
-    try:
-        n = int(raw) if raw else 2048
-    except ValueError:
-        n = 2048
-    return max(n, 1)
+    return envparse.env_int("HEAT_TPU_TELEMETRY_CAPACITY", 2048)
 
 
 _RING: "deque[dict]" = deque(maxlen=_env_capacity())
@@ -765,12 +762,7 @@ _TICK = itertools.count()
 
 
 def _env_sample_every() -> int:
-    raw = os.environ.get("HEAT_TPU_TELEMETRY_SAMPLE", "").strip()
-    try:
-        n = int(raw) if raw else 16
-    except ValueError:
-        n = 16
-    return max(n, 1)
+    return envparse.env_int("HEAT_TPU_TELEMETRY_SAMPLE", 16)
 
 
 _SAMPLE_EVERY = _env_sample_every()
@@ -884,7 +876,7 @@ def timed_call(fp: Optional[str], fn: Callable, *args, observer=None):
     try:
         import jax
 
-        jax.block_until_ready(out)
+        jax.block_until_ready(out)  # ht: HT002 ok — this IS timed_call's measurement barrier
     except Exception:  # timing must never break the computation
         pass
     dur = time.perf_counter() - t0
@@ -908,10 +900,24 @@ def roofline_report(top: Optional[int] = None, peaks: Optional[dict] = None) -> 
     rows sorted by total measured time, each carrying achieved GFLOP/s
     and GB/s, the roofline fractions, and a compute/memory-bound verdict
     (``unknown-peak`` when the device peaks are unknown — see
-    :mod:`heat_tpu.core.roofline` and ``HEAT_TPU_PEAKS``)."""
+    :mod:`heat_tpu.core.roofline` and ``HEAT_TPU_PEAKS``).  Rows whose
+    fingerprint carries a program-audit finding (unmodeled collective,
+    host transfer, dead donation) are marked ``audited_dirty`` — their
+    measured time is not trustworthy attribution."""
     from . import roofline
 
-    return roofline.report(programs(), top=top, peaks=peaks)
+    rep = roofline.report(programs(), top=top, peaks=peaks)
+    try:
+        from ..analysis import program_audit
+
+        dirty = program_audit.dirty_fingerprints()
+    except Exception:  # the analyzer must never break attribution
+        dirty = set()
+    if dirty:
+        for row in rep.get("rows", ()):
+            if row.get("fingerprint") in dirty:
+                row["audited_dirty"] = True
+    return rep
 
 
 # ------------------------------------------------------------- memory axis

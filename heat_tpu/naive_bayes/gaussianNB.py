@@ -108,7 +108,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         self._counts, self._means, self._vars = n_tot, mu_tot, var_tot
 
         # finalize public attributes
-        self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(xv, axis=0)))
+        self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(xv, axis=0)))  # ht: HT002 ok — one scalar readback finalizing fit; epsilon_ is a host hyperparameter
         self.class_count_ = DNDarray(
             n_tot, tuple(n_tot.shape), types.canonical_heat_type(n_tot.dtype), None, x.device, x.comm
         )

@@ -32,6 +32,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..core import envparse
+
 __all__ = [
     "Communication",
     "MeshComm",
@@ -382,10 +384,9 @@ def _looks_multiprocess() -> bool:
     """Cheap launcher-env sniff: does this look like one process of many?"""
 
     def _int(name: str) -> int:
-        try:
-            return int(os.environ.get(name, "1"))
-        except ValueError:
-            return 1
+        # strict parse (envparse.env_int): a malformed launcher variable
+        # must refuse to start, not silently come up single-process
+        return envparse.env_int(name, 1)
 
     tpu_workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     return (

@@ -65,6 +65,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import autotune, memtrack, telemetry
+from ..analysis import program_audit, sanitize
 from .collectives import (
     all_gather,
     jit_shard_map_cached,
@@ -563,6 +564,8 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     or ``None`` when the dispatcher picks GSPMD and the caller should run
     its own einsum.  ``a``/``b`` may be logical (zero-padded here) or
     already physical."""
+    sanitize.check_use(a, "overlap.matmul_raw")
+    sanitize.check_use(b, "overlap.matmul_raw")
     m, k = lshape_a
     k2, n = lshape_b
     if k != k2:
@@ -639,6 +642,11 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     )
     with telemetry.span("overlap.ring_" + case, m=m, k=k, n=n):
         fn = jit_shard_map_cached(_build_ring, comm.mesh, spec)
+        if program_audit.enabled():
+            program_audit.audit_program(
+                "ring_" + case, ring_fp, fn, (a, b) + tuple(extras),
+                expect="any",
+            )
         if tune is not None and tune.explore:
             # explore: measure BOTH arms — the ring program and the GSPMD
             # reference einsum it competes with — and return the ring
@@ -679,6 +687,9 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
         else:
             out = fn(a, b, *extras)
     memtrack.register_buffer(out, tag="output", split=out_split)
+    sanitize.collective_event(
+        "ring_" + case, axis=str(comm.split_axis), site="overlap.matmul_raw"
+    )
     _record(
         "ring_" + case, steps=comm.size, bps=bps, out_split=out_split,
         reason=reason, cache_hit=hit,

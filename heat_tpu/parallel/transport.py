@@ -71,6 +71,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core import autotune, guard, memtrack, telemetry
+from ..analysis import program_audit, sanitize
 from .collectives import shard_map_unchecked
 
 __all__ = [
@@ -285,6 +286,10 @@ def _with_oom_backoff(kind: str, run, tile_bytes: Optional[int], fp=None,
                 )
                 continue
             _STATS["last_tile_bytes"] = tb
+            # one chain link per successful tiled dispatch: the SPMD
+            # lockstep fingerprint (analysis.sanitize) must be identical
+            # on every rank
+            sanitize.collective_event(kind, site=f"transport.{kind}")
             return guard.corrupt(f"transport.{kind}", out)
 
 # Beyond this many distinct ring shifts the rechunk degenerates toward a
@@ -545,6 +550,7 @@ def tiled_resplit(
     reference (in-place ``resplit_``, stage intermediates).
     RESOURCE_EXHAUSTED retries with a halved tile budget (see
     :func:`_with_oom_backoff`)."""
+    sanitize.check_use(phys, "transport.tiled_resplit")
     S = comm.size
     n_a, n_b = int(gshape[sa]), int(gshape[sb])
     pa = int(phys.shape[sa]) // S
@@ -562,6 +568,11 @@ def tiled_resplit(
             comm.mesh, comm.split_axis, phys.ndim, int(sa), int(sb),
             n_a, n_b, tile_cols, n_tiles, bool(donate),
         )
+        if program_audit.enabled():
+            program_audit.audit_program(
+                "transport_resplit", fp, fn, (phys,),
+                donate=(0,) if donate else (), expect="any",
+            )
         return fn(phys)
 
     fp = None
@@ -1053,6 +1064,7 @@ def tiled_reshape(
     donated only with ``donate=True`` (pass it solely for buffers with no
     other live reference, e.g. a fused-tail pre-stage output the caller
     owns).  Callers must check :func:`reshape_applicable` first."""
+    sanitize.check_use(phys, "transport.tiled_reshape")
     S = comm.size
     gin = tuple(int(d) for d in gin)
     gout = tuple(int(d) for d in gout)

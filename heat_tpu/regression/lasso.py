@@ -122,7 +122,7 @@ class Lasso(RegressionMixin, BaseEstimator):
 
     def rmse(self, gt: DNDarray, yest: DNDarray) -> float:
         """Root mean squared error (reference: lasso.py:109)."""
-        return float(jnp.sqrt(jnp.mean((gt.larray - yest.larray) ** 2)))
+        return float(jnp.sqrt(jnp.mean((gt.larray - yest.larray) ** 2)))  # ht: HT002 ok — user-facing scalar metric API; the sync IS the contract
 
     @telemetry.span("lasso.fit")
     def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":

@@ -180,4 +180,4 @@ def dataset_irecv(dataset: Dataset) -> None:
     import jax
 
     for a in dataset.arrays:
-        jax.block_until_ready(a.larray)
+        jax.block_until_ready(a.larray)  # ht: HT002 ok — ingest barrier before epoch timing starts

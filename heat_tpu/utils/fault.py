@@ -51,7 +51,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-from ..core import guard, memtrack, telemetry
+from ..core import envparse, guard, memtrack, telemetry
 
 __all__ = [
     "ElasticFailure",
@@ -114,7 +114,7 @@ class FaultInjector:
         # count-deterministic, so equal seeds + equal arming = identical
         # fault schedules by construction.
         if seed is None:
-            seed = int(os.environ.get("HEAT_TPU_INJECT_SEED", "0"))
+            seed = envparse.env_int("HEAT_TPU_INJECT_SEED", 0, minimum=0)
         self.seed = int(seed)
         self._raises: Dict[int, bool] = {}
         self._nans: Dict[int, bool] = {}
@@ -484,7 +484,7 @@ def run_elastic(
             new_state, metrics = step_fn(state, batch_fn(step))
             # surface device-side NaN/Inf (and deferred XLA errors) now,
             # while recovery is still possible
-            jax.block_until_ready(metrics)
+            jax.block_until_ready(metrics)  # ht: HT002 ok — health check needs materialized metrics while recovery is possible
             if not health_check(metrics):
                 raise _UnhealthyStep(f"health check failed at step {step}")
         except Exception as exc:  # noqa: BLE001 — any step failure recovers

@@ -73,7 +73,7 @@ def monitor(name: Optional[str] = None, emit: bool = True) -> Callable:
             # timing there should end with a warmed scalar readback
             # (benchmarks/cb/config.py:drain).
             try:
-                jax.block_until_ready(out)
+                jax.block_until_ready(out)  # ht: HT002 ok — benchmark drain: the sync IS the measurement barrier
             except Exception:
                 pass
             wall = time.perf_counter() - t0

@@ -83,7 +83,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/16 suite (8-device mesh)"
+say "1/17 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -92,21 +92,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/16 core subset (4-device mesh)"
+say "2/17 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/16 parity audit (exits nonzero on any gap)"
+say "3/17 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/16 multi-chip dry-run"
+say "4/17 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/16 cb smoke"
+say "5/17 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -115,10 +115,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/16 copycheck"
+say "6/17 copycheck"
 python scripts/copycheck.py
 
-say "7/16 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/17 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -134,10 +134,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/16 fusion retrace guard (second call must hit the compile cache)"
+say "8/17 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/16 guardrails (fault injection + strict-guard retrace check)"
+say "9/17 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -148,7 +148,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/16 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/17 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -156,13 +156,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/16 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/17 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/16 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/17 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -193,7 +193,7 @@ for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/16 roofline attribution + perf-regression gate"
+say "13/17 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -242,7 +242,7 @@ print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
-say "14/16 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+say "14/17 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
 # the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
 # live end-to-end forensics check: census-bearing postmortem, informed
 # first retry from measured free HBM, and the memory counter track
@@ -307,7 +307,7 @@ print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
       f"bytes, {len(counters)} counter samples")
 EOF
 
-say "15/16 autotune (explore/exploit laws + live two-process warm start)"
+say "15/17 autotune (explore/exploit laws + live two-process warm start)"
 # the self-tuning-runtime contracts (ISSUE 11) at three mesh sizes, then a
 # live warm-start check: process 1 explores, resolves winners and saves its
 # table; process 2 loads the cache at import and must do ZERO explores —
@@ -391,7 +391,7 @@ assert not reg["regressions"], \
 print(f"autotuned check-regression OK: {len(reg['rows'])} rows judged")
 EOF
 
-say "16/16 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
+say "16/17 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
 # the kernel-tier contracts (ISSUE 12) at three mesh sizes: each test
 # scopes HEAT_TPU_PALLAS=interpret itself, so plain pytest runs suffice —
 # repack bit-exactness (incl. the pad-lane regression), fused QR panel vs
@@ -440,5 +440,43 @@ arms = {rows[n]["arm"] for n in rows}
 print(f"cb kernels OK: {len(rows)} rows (arms={sorted(arms)}), "
       f"{len(reg['rows'])} judged, {len(samples)} gauges")
 EOF
+
+say "17/17 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
+# the static gate: the shipped tree must self-check clean — every
+# residual finding either fixed, inline-justified (# ht: HTxxx ok), or
+# carried in analysis/baseline.json with a human reason
+python -m heat_tpu.analysis --check
+# the three-tier laws at three mesh sizes: rule fixtures +
+# counterexamples, baseline round-trip, auditor donation/callback/
+# collective laws, planted use-after-donate at mesh 4, sanitizer
+# attribution, collective-fingerprint determinism
+python -m pytest -q -p no:cacheprovider \
+  tests/test_analysis.py 2>&1 | tee /tmp/ci_analysis.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_analysis.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_analysis.py
+# live end-to-end: HEAT_TPU_SANITIZE=1 turns a real use-after-donate —
+# silent stale-data corruption on TPU, invisible to CPU CI — into an
+# attributed error naming both the donation and creation sites
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEAT_TPU_SANITIZE=1 HEAT_TPU_TELEMETRY=events python - <<'EOF_SAN'
+import heat_tpu as ht
+from heat_tpu.analysis import UseAfterDonateError
+from heat_tpu.parallel import transport
+
+x = ht.arange(64, dtype=ht.float32, split=0).reshape((8, 8)).resplit_(0)
+raw = x.parray                      # stale raw handle
+x.resplit_(1)                       # donates the old physical buffer
+try:
+    transport.tiled_resplit(raw, (8, 8), 0, 1, x.comm)
+except UseAfterDonateError as err:
+    msg = str(err)
+    assert "DNDarray.resplit_(donate)" in msg, msg
+    assert "<unledgered buffer>" not in msg, msg
+    print("live sanitizer OK:", msg.splitlines()[0][:100])
+else:
+    raise SystemExit("planted use-after-donate was NOT caught")
+EOF_SAN
 
 say "CI GREEN"

@@ -1,0 +1,542 @@
+"""SPMD hazard analyzer laws (heat_tpu.analysis).
+
+Three tiers, each held to its contract:
+
+* lint (HT001-HT005): every rule fires on its fixture and stays quiet on
+  the matched counterexample; inline ``# ht: HTxxx ok`` suppression and
+  the justified-baseline round trip work; the shipped tree self-checks
+  clean (the CI gate's law).
+* program audit: donation-aliasing, host-callback, and collective laws
+  on known-clean and known-dirty programs; a planted use-after-donate
+  through the real engine path is caught at mesh 4; clean engine
+  dispatches stay finding-free at mesh sizes 1, 4, and 8 — and audited
+  fingerprints taint their roofline rows.
+* sanitizer: donated-buffer poisoning raises with creation + donation
+  site attribution; id-recycling cannot convict an innocent buffer; the
+  collective-sequence fingerprint is deterministic and order-sensitive.
+"""
+
+import gc
+import json
+import os
+import tempfile
+import textwrap
+import unittest
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.analysis import UseAfterDonateError, lint, program_audit, sanitize
+from heat_tpu.core import envparse, memtrack, telemetry
+from heat_tpu.parallel import transport
+from heat_tpu.parallel.collectives import shard_map_unchecked
+
+from .base import TestCase
+
+
+def _mesh(n):
+    from heat_tpu.parallel.mesh import local_mesh
+
+    return local_mesh(n)
+
+
+def _require_devices(tc, n):
+    if len(jax.devices()) < n:
+        tc.skipTest(f"needs >= {n} devices")
+
+
+def _codes(src):
+    return [f.code for f in lint.lint_source(textwrap.dedent(src))]
+
+
+class _Scope:
+    """Scoped analyzer toggles + clean telemetry/memtrack on both sides."""
+
+    def __init__(self, sanitize_on=None, audit=None, level=None):
+        self.sanitize_on = sanitize_on
+        self.audit = audit
+        self.level = level
+
+    def __enter__(self):
+        self.prev_san = sanitize.set_enabled(self.sanitize_on)
+        self.prev_audit = program_audit.set_mode(self.audit)
+        self.prev_level = telemetry.set_level(self.level) if self.level else None
+        sanitize.reset()
+        program_audit.reset()
+        telemetry.clear_events()
+        telemetry.reset_programs()
+        memtrack.reset()
+        return self
+
+    def __exit__(self, *exc):
+        sanitize.set_enabled(self.prev_san)
+        program_audit.set_mode(self.prev_audit)
+        if self.prev_level is not None:
+            telemetry.set_level(self.prev_level)
+        sanitize.reset()
+        program_audit.reset()
+        telemetry.clear_events()
+        telemetry.reset_programs()
+        memtrack.reset()
+        return False
+
+
+# ------------------------------------------------------------------ lint
+
+
+class TestLintRules(TestCase):
+    def test_ht001_fires_on_raw_env_int_parse(self):
+        codes = _codes(
+            """
+            import os
+            n = int(os.environ.get("HEAT_TPU_X", "4"))
+            """
+        )
+        self.assertIn("HT001", codes)
+
+    def test_ht001_quiet_on_env_int_and_string_reads(self):
+        codes = _codes(
+            """
+            import os
+            from heat_tpu.core.envparse import env_int
+            n = env_int("HEAT_TPU_X", 4)
+            mode = os.environ.get("HEAT_TPU_MODE", "auto")
+            """
+        )
+        self.assertNotIn("HT001", codes)
+
+    def test_ht002_fires_on_unwrapped_host_sync(self):
+        for snippet in (
+            "def f(x):\n    y = jnp.sum(x)\n    return float(y)\n",
+            "def f(x):\n    return jnp.dot(x, x).block_until_ready()\n",
+            "def f(x):\n    return jnp.max(x).item()\n",
+        ):
+            self.assertIn("HT002", _codes(snippet), snippet)
+
+    def test_ht002_quiet_on_metadata_and_timed_call(self):
+        codes = _codes(
+            """
+            def f(x):
+                a = float(x.shape[0])
+                b = int(jnp.dtype(x.dtype).itemsize)
+                out = telemetry.timed_call(fp, lambda: jnp.sum(x).item())
+                return a + b, out
+            """
+        )
+        self.assertNotIn("HT002", codes)
+
+    def test_ht003_fires_on_data_dependent_branch_gating_collective(self):
+        codes = _codes(
+            """
+            def f(x, comm):
+                s = jnp.sum(x)
+                if s > 0:
+                    comm.all_gather(x)
+            """
+        )
+        self.assertIn("HT003", codes)
+
+    def test_ht003_quiet_on_shape_branch(self):
+        codes = _codes(
+            """
+            def f(x, comm):
+                if x.shape[0] > 2:
+                    comm.all_gather(x)
+            """
+        )
+        self.assertNotIn("HT003", codes)
+
+    def test_ht004_fires_on_orphan_counter_dict(self):
+        codes = _codes(
+            """
+            _STATS = {"hits": 0}
+            def f():
+                _STATS["hits"] += 1
+            """
+        )
+        self.assertIn("HT004", codes)
+
+    def test_ht004_quiet_on_registered_group(self):
+        codes = _codes(
+            """
+            _STATS = telemetry.register_group("g", {"hits": 0})
+            def f():
+                _STATS["hits"] += 1
+            """
+        )
+        self.assertNotIn("HT004", codes)
+
+    def test_ht005_fires_on_use_after_donate_argnums(self):
+        codes = _codes(
+            """
+            def f(x):
+                g = jax.jit(step, donate_argnums=(0,))
+                y = g(x)
+                return x + y
+            """
+        )
+        self.assertIn("HT005", codes)
+
+    def test_ht005_quiet_when_donated_name_rebound(self):
+        codes = _codes(
+            """
+            def f(x):
+                g = jax.jit(step, donate_argnums=(0,))
+                x = g(x)
+                return x + 1
+            """
+        )
+        self.assertNotIn("HT005", codes)
+
+    def test_inline_suppression_silences_with_reason(self):
+        src = (
+            "import os\n"
+            'n = int(os.environ.get("HEAT_TPU_X", "4"))'
+            "  # ht: HT001 ok — fixture justification\n"
+        )
+        self.assertNotIn("HT001", [f.code for f in lint.lint_source(src)])
+
+    def test_syntax_error_becomes_ht000(self):
+        self.assertEqual(_codes("def broken(:\n"), ["HT000"])
+
+    def test_identity_stable_under_line_drift(self):
+        src = 'import os\nn = int(os.environ.get("HEAT_TPU_X", "4"))\n'
+        drifted = "import os\n\n\n" + src.split("\n", 1)[1] + "\n"
+        a = lint.lint_source(src, relpath="fix.py")
+        b = lint.lint_source(drifted, relpath="fix.py")
+        self.assertEqual(a[0].identity, b[0].identity)
+        self.assertNotEqual(a[0].line, b[0].line)
+
+
+class TestBaselineRoundTrip(TestCase):
+    def test_update_then_justify_then_check(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            fixture = os.path.join(tmp, "fixture.py")
+            with open(fixture, "w") as fh:
+                fh.write(
+                    'import os\nn = int(os.environ.get("HEAT_TPU_X", "1"))\n'
+                )
+            bl = os.path.join(tmp, "baseline.json")
+            # fresh finding blocks
+            self.assertEqual(lint.main([fixture, "--check", "--baseline", bl]), 1)
+            # update-baseline records it with a TODO reason -> still blocks
+            self.assertEqual(
+                lint.main([fixture, "--update-baseline", "--baseline", bl]), 0
+            )
+            self.assertEqual(lint.main([fixture, "--check", "--baseline", bl]), 1)
+            # a human justification unblocks
+            with open(bl) as fh:
+                doc = json.load(fh)
+            self.assertEqual(len(doc["findings"]), 1)
+            doc["findings"][0]["reason"] = "fixture: intentionally raw"
+            with open(bl, "w") as fh:
+                json.dump(doc, fh)
+            self.assertEqual(lint.main([fixture, "--check", "--baseline", bl]), 0)
+            # fixing the code leaves a stale entry; check still passes and
+            # a fresh --update-baseline drops it
+            with open(fixture, "w") as fh:
+                fh.write("n = 1\n")
+            self.assertEqual(lint.main([fixture, "--check", "--baseline", bl]), 0)
+            lint.main([fixture, "--update-baseline", "--baseline", bl])
+            with open(bl) as fh:
+                self.assertEqual(json.load(fh)["findings"], [])
+
+    def test_shipped_tree_self_checks_clean(self):
+        # the CI gate's law: the repo's own baseline justifies everything
+        self.assertEqual(lint.check(), 0)
+
+
+class TestEnvParse(TestCase):
+    def test_env_int_contract(self):
+        self.assertEqual(envparse.env_int("HT_T_MISSING", 7), 7)
+        self.assertEqual(envparse.env_int("HT_T", 7, env={"HT_T": "12"}), 12)
+        with self.assertRaises(ValueError):
+            envparse.env_int("HT_T", 7, env={"HT_T": "banana"})
+        with self.assertRaises(ValueError):
+            envparse.env_int("HT_T", 7, minimum=1, env={"HT_T": "0"})
+        self.assertEqual(
+            envparse.env_int("HT_T", 7, minimum=0, env={"HT_T": "0"}), 0
+        )
+
+    def test_autotune_reexports_env_int(self):
+        from heat_tpu.core import autotune
+
+        self.assertIs(autotune.env_int, envparse.env_int)
+
+
+# --------------------------------------------------------------- auditor
+
+
+class TestProgramAudit(TestCase):
+    def test_mode_parsing_and_override(self):
+        with _Scope(audit="jaxpr"):
+            self.assertTrue(program_audit.enabled())
+            self.assertEqual(program_audit.mode(), "jaxpr")
+        with _Scope(audit="off"):
+            self.assertFalse(program_audit.enabled())
+        with self.assertRaises(ValueError):
+            program_audit.set_mode("banana")
+
+    def test_donation_aliasing_law(self):
+        with _Scope(audit="jaxpr"):
+            x = jnp.ones((8, 8), jnp.float32)
+            clean = jax.jit(lambda v: v + 1.0, donate_argnums=(0,))
+            got = program_audit.audit_program(
+                "fixture", "fp-clean", clean, (x,), donate=(0,), expect="none"
+            )
+            self.assertEqual(got, [])
+            # donating an input no output can alias is a recorded waste
+            dead = jax.jit(lambda v: jnp.sum(v), donate_argnums=(0,))
+            got = program_audit.audit_program(
+                "fixture", "fp-dead", dead, (x,), donate=(0,), expect="none"
+            )
+            self.assertEqual([f["rule"] for f in got], ["donation_unaliasable"])
+
+    def test_host_callback_detected(self):
+        with _Scope(audit="jaxpr"):
+            def chatty(v):
+                jax.debug.print("v0={x}", x=v[0])
+                return v * 2.0
+
+            got = program_audit.audit_program(
+                "fixture", "fp-cb", chatty, (jnp.ones((4,)),), expect="none"
+            )
+            self.assertIn("host_transfer", [f["rule"] for f in got])
+
+    def test_unexpected_collective_in_modeled_local_program(self):
+        _require_devices(self, 4)
+        comm = _mesh(4)
+        with _Scope(audit="jaxpr"):
+            fn = shard_map_unchecked(
+                lambda v: jax.lax.psum(v, comm.split_axis),
+                comm.mesh,
+                in_specs=jax.sharding.PartitionSpec(comm.split_axis),
+                out_specs=jax.sharding.PartitionSpec(),
+            )
+            x = jnp.ones((8,), jnp.float32)
+            got = program_audit.audit_program(
+                "fixture", "fp-coll", fn, (x,), expect="none"
+            )
+            self.assertIn("unexpected_collective", [f["rule"] for f in got])
+            # the same program under the engine contract is expected
+            program_audit.reset()
+            got = program_audit.audit_program(
+                "fixture", "fp-coll2", fn, (x,), expect="any"
+            )
+            self.assertNotIn("unexpected_collective", [f["rule"] for f in got])
+
+    def test_walk_dedups_per_fingerprint_but_not_poison_checks(self):
+        with _Scope(audit="jaxpr"):
+            x = jnp.ones((4,), jnp.float32)
+            fn = jax.jit(lambda v: v * 2.0)
+            program_audit.audit_program("fixture", "fp-d", fn, (x,))
+            audits0 = program_audit._STATS["audits"]
+            program_audit.audit_program("fixture", "fp-d", fn, (x,))
+            self.assertEqual(program_audit._STATS["audits"], audits0)
+            # a poisoned input on the SAME fingerprint is still caught
+            sanitize.poison(x, donated_site="fixture-site")
+            got = program_audit.audit_program("fixture", "fp-d", fn, (x,))
+            self.assertEqual([f["rule"] for f in got], ["use_after_donate"])
+
+    def test_clean_engine_resplit_audits_quiet_across_mesh_sizes(self):
+        sizes = [n for n in (1, 4, 8) if n <= len(jax.devices())]
+        for n in sizes:
+            comm = _mesh(n)
+            with _Scope(audit="jaxpr", level="events"):
+                x = ht.arange(
+                    64, dtype=ht.float32, split=0, comm=comm
+                ).reshape((8, 8))
+                x = x.resplit_(0).resplit_(1)
+                rules = [f["rule"] for f in program_audit.findings()]
+                self.assertEqual(
+                    rules, [], f"mesh {n}: unexpected findings {rules}"
+                )
+
+    def test_planted_use_after_donate_caught_at_mesh_4(self):
+        _require_devices(self, 4)
+        comm = _mesh(4)
+        with _Scope(audit="jaxpr", level="events"):
+            x = ht.arange(
+                64, dtype=ht.float32, split=0, comm=comm
+            ).reshape((8, 8)).resplit_(0)
+            raw = x.parray  # stale raw handle kept across the donation
+            x.resplit_(1)
+            self.assertGreaterEqual(sanitize._STATS["poisoned"], 1)
+            try:
+                transport.tiled_resplit(raw, (8, 8), 0, 1, comm)
+            except RuntimeError:
+                pass  # backends that honor deletion refuse the dispatch
+            rules = [f["rule"] for f in program_audit.findings()]
+            self.assertIn("use_after_donate", rules)
+            self.assertTrue(program_audit.dirty_fingerprints())
+
+    def test_hlo_mode_clean_program_no_findings(self):
+        with _Scope(audit="hlo"):
+            x = jnp.ones((8, 8), jnp.float32)
+            fn = jax.jit(lambda v: v * 2.0 + 1.0)
+            got = program_audit.audit_program(
+                "fixture", "fp-hlo", fn, (x,), expect="none"
+            )
+            self.assertEqual(got, [])
+
+    def test_findings_mark_roofline_rows_audited_dirty(self):
+        with _Scope(audit="jaxpr", level="events"):
+            fp = telemetry.fingerprint(("analysis-fixture",))
+            telemetry.ensure_program(
+                fp, kind="fixture", ops=1, flops=1e6, hbm_bytes=1e6,
+                mesh={"devices": 1},
+            )
+            for _ in range(3):
+                telemetry.record_timing(fp, 0.001)
+            x = jnp.ones((4,), jnp.float32)
+            sanitize.poison(x, donated_site="fixture-site")
+            program_audit.audit_program(
+                "fixture", fp, jax.jit(lambda v: v + 1), (x,)
+            )
+            rows = telemetry.roofline_report()["rows"]
+            row = next(r for r in rows if r["fingerprint"] == fp)
+            self.assertTrue(row.get("audited_dirty"))
+            clean = [r for r in rows if r["fingerprint"] != fp]
+            self.assertTrue(all(not r.get("audited_dirty") for r in clean))
+
+
+# ------------------------------------------------------------- sanitizer
+
+
+class TestSanitizer(TestCase):
+    def test_off_by_default_and_override(self):
+        self.assertFalse(sanitize.enabled())
+        prev = sanitize.set_enabled(True)
+        try:
+            self.assertTrue(sanitize.enabled())
+        finally:
+            sanitize.set_enabled(prev)
+
+    def test_use_after_donate_raises_with_attribution(self):
+        _require_devices(self, 4)
+        comm = _mesh(4)
+        with _Scope(sanitize_on=True, level="events"):
+            x = ht.arange(
+                64, dtype=ht.float32, split=0, comm=comm
+            ).reshape((8, 8)).resplit_(0)
+            raw = x.parray
+            x.resplit_(1)  # donates the old physical buffer
+            n0 = sanitize._STATS["use_after_donate"]
+            with self.assertRaises(UseAfterDonateError) as cm:
+                transport.tiled_resplit(raw, (8, 8), 0, 1, comm)
+            msg = str(cm.exception)
+            self.assertIn("use-after-donate", msg)
+            self.assertIn("DNDarray.resplit_(donate)", msg)
+            # with the residency ledger on, the message names the real
+            # creation site, not the unledgered placeholder
+            self.assertNotIn("<unledgered buffer>", msg)
+            self.assertEqual(sanitize._STATS["use_after_donate"], n0 + 1)
+            evts = telemetry.events("analysis_finding")
+            self.assertTrue(
+                any(e.get("rule") == "use_after_donate" for e in evts)
+            )
+
+    def test_fusion_funnel_checks_leaves(self):
+        _require_devices(self, 4)
+        comm = _mesh(4)
+        with _Scope(sanitize_on=True, level="events"):
+            x = ht.arange(
+                64, dtype=ht.float32, split=0, comm=comm
+            ).reshape((8, 8)).resplit_(0)
+            raw = x.parray
+            x.resplit_(1)
+            y = ht.array(
+                np.ones((8, 8), np.float32), split=1, comm=comm
+            )
+            with self.assertRaises(UseAfterDonateError):
+                # rebuild a DNDarray around the poisoned buffer and pull
+                # it through the lazy engine's materialize funnel
+                stale = ht.DNDarray(
+                    raw, (8, 8), ht.float32, 0, y.device, comm
+                )
+                (stale + 1.0).numpy()
+
+    def test_clean_buffers_never_raise(self):
+        _require_devices(self, 4)
+        comm = _mesh(4)
+        with _Scope(sanitize_on=True, level="events"):
+            x = ht.arange(
+                64, dtype=ht.float32, split=0, comm=comm
+            ).reshape((8, 8)).resplit_(0)
+            out = x.resplit_(1)
+            self.assertEqual(tuple(out.shape), (8, 8))
+            np.testing.assert_allclose(
+                out.numpy(), np.arange(64, dtype=np.float32).reshape(8, 8)
+            )
+
+    def test_id_recycling_cannot_convict_innocent_buffer(self):
+        with _Scope(sanitize_on=True):
+            x = jnp.ones((4,), jnp.float32)
+            sanitize.poison(x, donated_site="fixture-site")
+            entry = sanitize._POISON[id(x)]
+            # simulate the donated buffer dying and its id being recycled
+            victim = np.zeros(3)
+            entry["ref"] = weakref.ref(victim)
+            del victim
+            gc.collect()
+            self.assertIsNone(sanitize.poison_entry(x))
+            self.assertNotIn(id(x), sanitize._POISON)
+            sanitize.check_use(x, "fixture")  # must not raise
+
+    def test_poison_ledger_is_bounded(self):
+        with _Scope(sanitize_on=True):
+            keep = []
+            for i in range(sanitize._POISON_MAX + 16):
+                v = np.array([i])
+                keep.append(v)
+                sanitize.poison(v, donated_site="fixture-site")
+            self.assertLessEqual(len(sanitize._POISON), sanitize._POISON_MAX)
+
+
+class TestCollectiveFingerprint(TestCase):
+    def test_chain_deterministic_and_order_sensitive(self):
+        with _Scope(sanitize_on=True):
+            seq = [("resplit", None), ("ring_ag", "d"), ("rechunk", None)]
+            for op, axis in seq:
+                sanitize.collective_event(op, axis=axis, site=f"t.{op}")
+            a = sanitize.collective_fingerprint()
+            self.assertEqual(a["n"], 3)
+            sanitize.reset_collective_fingerprint()
+            for op, axis in seq:
+                sanitize.collective_event(op, axis=axis, site=f"t.{op}")
+            self.assertEqual(sanitize.collective_fingerprint()["digest"], a["digest"])
+            # a reordered sequence — the divergence the mesh law catches —
+            # yields a different digest
+            sanitize.reset_collective_fingerprint()
+            for op, axis in reversed(seq):
+                sanitize.collective_event(op, axis=axis, site=f"t.{op}")
+            self.assertNotEqual(
+                sanitize.collective_fingerprint()["digest"], a["digest"]
+            )
+
+    def test_engine_dispatches_extend_the_chain(self):
+        _require_devices(self, 4)
+        comm = _mesh(4)
+        with _Scope(sanitize_on=True, level="events"):
+            x = ht.arange(
+                64, dtype=ht.float32, split=0, comm=comm
+            ).reshape((8, 8)).resplit_(0)
+            n0 = sanitize.collective_fingerprint()["n"]
+            x.resplit_(1)  # one tiled transport dispatch
+            fpr = sanitize.collective_fingerprint()
+            self.assertGreater(fpr["n"], n0)
+            self.assertTrue(
+                any(op == "resplit" for (_, op, _) in fpr["trail"])
+            )
+
+    def test_chain_quiet_when_disabled(self):
+        with _Scope(sanitize_on=False):
+            sanitize.collective_event("resplit", site="t.resplit")
+            self.assertEqual(sanitize.collective_fingerprint()["n"], 0)
+
+
+if __name__ == "__main__":
+    unittest.main()
