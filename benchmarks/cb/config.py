@@ -185,3 +185,8 @@ REPACK_IN, REPACK_OUT = (
 QR_PANEL_M, QR_PANEL_N = (262_144, 256) if ON_TPU else (4_096, 128)
 LASSO_K_M, LASSO_K_N = (8_192, 512) if ON_TPU else (2_000, 32)
 RESNET_BATCH, RESNET_IMG = (256, 224) if ON_TPU else (8, 32)
+# serving_batch (ISSUE 14): mixed 1-4-row predict requests through the
+# batched front door vs the same stream dispatched sequentially; sized
+# so the CPU row finishes in seconds while still coalescing real batches
+SERVING_F, SERVING_K = (64, 8) if ON_TPU else (32, 8)
+SERVING_REQS = 256 if ON_TPU else 96

@@ -62,6 +62,11 @@ TOLERANCE = {
     "reshape_repack": 0.5,
     "qr_panel_fused": 0.5,
     "lasso_sweep_fused": 0.5,
+    # serving.py's own note: the batched wall is dispatch amortization
+    # with Python thread scheduling riding on top (8 submitter threads +
+    # the batcher worker on a CPU CI mesh), so run-to-run spread is
+    # scheduler noise, not kernel time
+    "serving_batch": 0.5,
 }
 
 _ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")

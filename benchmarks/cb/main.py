@@ -17,6 +17,7 @@ import linalg
 import manipulations
 import nn
 import regression
+import serving
 
 from heat_tpu.core import telemetry as _telemetry
 from heat_tpu.utils import monitor as _monitor
@@ -89,7 +90,8 @@ if __name__ == "__main__":
         "--only",
         default=None,
         help="comma-separated subset: "
-             "linalg,cluster,manipulations,nn,regression,fusion,kernels",
+             "linalg,cluster,manipulations,nn,regression,fusion,kernels,"
+             "serving",
     )
     ap.add_argument(
         "--check-regression",
@@ -109,6 +111,7 @@ if __name__ == "__main__":
         "manipulations": manipulations.run,
         "nn": nn.run,
         "regression": regression.run,
+        "serving": serving.run,
     }
     selected = (
         [s.strip() for s in args.only.split(",") if s.strip()]

@@ -53,8 +53,9 @@ from . import sparse
 from . import utils
 
 # nn / optim / models pull in flax and optax (the optional "nn" extra);
-# load them lazily so a base install can import the array library
-_LAZY_SUBPACKAGES = ("nn", "optim", "models")
+# serving spins up its telemetry group and worker machinery — load all
+# of them lazily so a base install can import the array library
+_LAZY_SUBPACKAGES = ("nn", "optim", "models", "serving")
 
 
 def __getattr__(name):

@@ -67,6 +67,7 @@ __all__ = [
     "kernel_key",
     "load",
     "matmul_key",
+    "merge",
     "note_budget_seed",
     "note_prior",
     "observe",
@@ -513,6 +514,45 @@ def _finite(t):
     return t if t < 1e9 else 1e9
 
 
+def _parse_cache_doc(doc):
+    """Validate + parse one cache document (the shared back half of
+    :func:`load` and :func:`merge`).  Raises on anything :func:`load`
+    would refuse — a merge must never launder a row load() rejects."""
+    if not isinstance(doc, dict):
+        raise ValueError("not a JSON object")
+    if doc.get("version") != CACHE_VERSION:
+        raise ValueError(f"cache version {doc.get('version')!r}, "
+                         f"want {CACHE_VERSION}")
+    if doc.get("library") != __version__:
+        raise ValueError(f"library {doc.get('library')!r}, "
+                         f"want {__version__!r}")
+    entries = doc["entries"]
+    parsed = []
+    for ent in entries:
+        w = ent.get("winner")
+        if w is not None and w not in _KNOWN_ARMS:
+            raise ValueError(f"unknown arm {w!r}")
+        # the entry's own arm set round-trips (ring/gspmd AND
+        # classic/kernel entries share one cache file); arm names
+        # outside the registry poison the whole file — a winner
+        # this build cannot dispatch must not warm-start anything
+        arm_names = tuple(ent.get("arms", {})) or ARMS
+        for a in arm_names:
+            if a not in _KNOWN_ARMS:
+                raise ValueError(f"unknown arm {a!r}")
+        if w is not None and w not in arm_names:
+            raise ValueError(f"winner {w!r} outside entry arms")
+        parsed.append((
+            (str(ent["fingerprint"]), str(ent["device_kind"])),
+            w,
+            ent.get("best_s"),
+            str(ent.get("desc") or ""),
+            {a: [float(t) for t in ent.get("arms", {}).get(a, [])]
+             for a in arm_names},
+        ))
+    return parsed
+
+
 def load(path) -> int:
     """Restore a saved tuning table.  A corrupt, stale-version, or
     different-library file is IGNORED with a recorded ``fallback`` event
@@ -523,38 +563,7 @@ def load(path) -> int:
     try:
         with open(path) as f:
             doc = json.load(f)
-        if not isinstance(doc, dict):
-            raise ValueError("not a JSON object")
-        if doc.get("version") != CACHE_VERSION:
-            raise ValueError(f"cache version {doc.get('version')!r}, "
-                             f"want {CACHE_VERSION}")
-        if doc.get("library") != __version__:
-            raise ValueError(f"library {doc.get('library')!r}, "
-                             f"want {__version__!r}")
-        entries = doc["entries"]
-        parsed = []
-        for ent in entries:
-            w = ent.get("winner")
-            if w is not None and w not in _KNOWN_ARMS:
-                raise ValueError(f"unknown arm {w!r}")
-            # the entry's own arm set round-trips (ring/gspmd AND
-            # classic/kernel entries share one cache file); arm names
-            # outside the registry poison the whole file — a winner
-            # this build cannot dispatch must not warm-start anything
-            arm_names = tuple(ent.get("arms", {})) or ARMS
-            for a in arm_names:
-                if a not in _KNOWN_ARMS:
-                    raise ValueError(f"unknown arm {a!r}")
-            if w is not None and w not in arm_names:
-                raise ValueError(f"winner {w!r} outside entry arms")
-            parsed.append((
-                (str(ent["fingerprint"]), str(ent["device_kind"])),
-                w,
-                ent.get("best_s"),
-                str(ent.get("desc") or ""),
-                {a: [float(t) for t in ent.get("arms", {}).get(a, [])]
-                 for a in arm_names},
-            ))
+        parsed = _parse_cache_doc(doc)
     except Exception as exc:
         _STATS["fallbacks"] += 1
         telemetry.record_event(
@@ -574,6 +583,79 @@ def load(path) -> int:
         "autotune_cache", action="load", path=path, entries=len(parsed),
     )
     return len(parsed)
+
+
+def _merge_prefers(new: dict, old: dict) -> bool:
+    """Newest-best selection: a resolved winner beats an unresolved
+    entry; between resolved entries the lower ``best_s`` wins; every
+    tie goes to ``new`` — the later file in the merge argument list."""
+    nw, ow = new["winner"], old["winner"]
+    if (nw is None) != (ow is None):
+        return nw is not None
+    nb, ob = new["best_s"], old["best_s"]
+    if nw is not None and nb is not None and ob is not None and nb != ob:
+        return nb < ob
+    return True
+
+
+def merge(paths, out) -> str:
+    """Merge several per-process tuning caches into ONE warm-start file.
+
+    The serving-fleet story (ROADMAP item 2): every serving process
+    :func:`save`\\ s its own table; deployment ships the union so the
+    next generation warm-starts with zero explores.  Selection is
+    **newest-best** per (fingerprint, device kind, arm set) — see
+    :func:`_merge_prefers`.  A file :func:`load` would refuse (corrupt,
+    stale cache version, different library version) is skipped whole
+    with a recorded ``fallback`` event; its rows never reach the output.
+    The merged file is written atomically in :func:`save`'s format and
+    the path returned, also reachable as
+    ``python -m heat_tpu.core.autotune --merge IN... --out OUT``."""
+    chosen: Dict[tuple, dict] = {}
+    sources = 0
+    for path in paths:
+        path = os.fspath(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            parsed = _parse_cache_doc(doc)
+        except Exception as exc:
+            _STATS["fallbacks"] += 1
+            telemetry.record_event(
+                "fallback", site="autotune.merge", path=path, error=str(exc),
+            )
+            continue
+        sources += 1
+        for key, w, best, desc, arms in parsed:
+            entry = {
+                "fingerprint": key[0],
+                "device_kind": key[1],
+                "winner": w,
+                "best_s": _finite(float(best)) if best is not None else None,
+                "desc": desc,
+                "arms": {a: [_finite(t) for t in d] for a, d in arms.items()},
+            }
+            mkey = key + (tuple(sorted(arms)),)
+            old = chosen.get(mkey)
+            if old is None or _merge_prefers(entry, old):
+                chosen[mkey] = entry
+    doc = {
+        "version": CACHE_VERSION,
+        "library": __version__,
+        "entries": sorted(
+            chosen.values(), key=lambda e: (e["fingerprint"], e["device_kind"])
+        ),
+    }
+    out = os.fspath(out)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, out)
+    telemetry.record_event(
+        "autotune_cache", action="merge", path=out,
+        entries=len(chosen), sources=sources,
+    )
+    return out
 
 
 def _enable_jax_compilation_cache(path: str) -> None:
@@ -645,3 +727,34 @@ def report(top: Optional[int] = None) -> dict:
 
 
 _init_from_env()
+
+
+# ---------------------------------------------------------------------- CLI
+
+
+def _main(argv=None) -> int:
+    """``python -m heat_tpu.core.autotune --merge IN [IN ...] --out OUT``
+    — fleet-cache merge without writing a line of Python."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m heat_tpu.core.autotune",
+        description="Merge per-process tuning caches into one warm-start file.",
+    )
+    parser.add_argument(
+        "--merge", nargs="+", metavar="IN", required=True,
+        help="input cache files (later files win ties: newest last)",
+    )
+    parser.add_argument(
+        "--out", metavar="OUT", required=True, help="merged output path",
+    )
+    opts = parser.parse_args(argv)
+    out = merge(opts.merge, opts.out)
+    with open(out) as f:
+        entries = len(json.load(f)["entries"])
+    print(f"merged {len(opts.merge)} cache(s) -> {out} ({entries} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -78,6 +78,7 @@ __all__ = [
     "suggest_budget",
     "summary",
     "tag_buffer",
+    "would_fit",
 ]
 
 # the tag vocabulary: why a buffer is (still) resident
@@ -433,6 +434,27 @@ def suggest_budget(
             return None
     granted = int((int(free) - int(headroom)) * float(fraction))
     return max(int(floor), min(int(request), granted))
+
+
+def would_fit(
+    nbytes: int,
+    *,
+    fraction: float = 0.5,
+    headroom: int = 0,
+) -> Optional[bool]:
+    """Admission-control face of :func:`suggest_budget`: does an
+    ``nbytes`` staging allocation fit inside the suggested budget?
+
+    Returns ``None`` on statsless backends (CPU) — the caller should
+    admit, never shed on fake numbers.  The serving front door's
+    ``hbm_pressure`` shed decision routes through here so its clamp
+    semantics stay identical to transport's OOM retry and the autotune
+    seeding sites."""
+    nbytes = int(nbytes)
+    granted = suggest_budget(nbytes, fraction=fraction, headroom=headroom)
+    if granted is None:
+        return None
+    return granted >= nbytes
 
 
 def device_peaks() -> Dict[str, int]:

@@ -108,6 +108,7 @@ __all__ = [
     "reset_group",
     "reset_programs",
     "roofline_report",
+    "serving_report",
     "set_capacity",
     "set_level",
     "set_sample_every",
@@ -966,6 +967,18 @@ def autotune_report(top: Optional[int] = None) -> dict:
     from . import autotune
 
     return autotune.report(top=top)
+
+
+def serving_report() -> dict:
+    """Snapshot of the ``serving`` counter group (registered by
+    :mod:`heat_tpu.serving` on import): accepted/rejected/batch/shed
+    counters plus per-endpoint latency p50/p99.  Empty dict until the
+    serving front door has been imported — surfaced here so the ops
+    story (``snapshot()`` / ``roofline_report()`` / ``autotune_report()``
+    / ``serving_report()``) lives behind one module."""
+    if "serving" not in _GROUPS:
+        return {}
+    return snapshot_group("serving")
 
 
 def reset() -> None:

@@ -1,0 +1,36 @@
+"""Elastic batch-serving front door (ISSUE 14).
+
+Turns the fitted estimator/nn surface into a concurrent request path:
+
+>>> import heat_tpu as ht
+>>> from heat_tpu import serving
+>>> eng = serving.ServingEngine()
+>>> eng.register("kmeans", model, feature_dim=32, warm=True)
+>>> labels = eng.predict("kmeans", one_row)          # blocking
+>>> fut = eng.submit("kmeans", four_rows)            # async Future
+
+Three layers, one module each:
+
+* :mod:`~heat_tpu.serving.batcher` — shape-agnostic request coalescing
+  (flush on full bucket / latency deadline / drain);
+* :mod:`~heat_tpu.serving.engine` — endpoint registry, power-of-two
+  bucket ladders, compile-once step cache, telemetry;
+* :mod:`~heat_tpu.serving.admission` — bounded queue depth, HBM- and
+  stall-aware load shedding (:class:`RequestRejected`), graceful drain.
+
+Importing the package registers the ``serving`` telemetry group; see
+``docs/quick_start.md`` §13 for the end-to-end walkthrough.
+"""
+
+from .admission import AdmissionController, RequestRejected
+from .batcher import DynamicBatcher, Request
+from .engine import Endpoint, ServingEngine
+
+__all__ = [
+    "AdmissionController",
+    "DynamicBatcher",
+    "Endpoint",
+    "Request",
+    "RequestRejected",
+    "ServingEngine",
+]

@@ -1,0 +1,457 @@
+"""Endpoint registry + compile-once step cache: the serving engine.
+
+A :class:`ServingEngine` turns fitted estimators (``KMeans.predict``,
+``Lasso.predict``, ``GaussianNB.predict``, ``KNeighborsClassifier
+.predict``) or any ``DNDarray -> DNDarray`` callable (``nn.functional.
+linear`` closures) into concurrently callable endpoints:
+
+* :meth:`register` fixes the endpoint's feature dim / dtype / split and
+  derives its **bucket ladder** — power-of-two row counts from
+  ``min_bucket`` up through ``max_batch`` — so every batch the mesh ever
+  sees has one of a handful of shapes;
+* :meth:`submit` validates + admits a request and hands it to the
+  :class:`~heat_tpu.serving.batcher.DynamicBatcher`; the returned
+  :class:`~concurrent.futures.Future` resolves to exactly the caller's
+  rows (per-request unpadding is a contiguous slice);
+* the worker pads each coalesced batch up to the smallest bucket and
+  runs it through a **compile-once step cache**: one step per
+  (endpoint, bucket), fingerprinted into the telemetry program ledger.
+  Identical shapes mean the fusion/overlap/autotune caches underneath
+  never retrace after warmup — and with ``HEAT_TPU_AUTOTUNE_CACHE`` (+
+  the JAX persistent compilation cache it enables) a fresh process does
+  **zero explores**: every decision is ``cached`` from the first batch.
+
+Telemetry: the ``serving`` counter group (accepted / rejected / batched
+/ padded_rows / flush_cause / shed reasons / step compiles), per-
+endpoint latency p50/p99 exported through ``export_prometheus()`` as
+``heat_tpu_serving_latency_<endpoint>_p50_s``, flight-recorder events
+for shed / drain / stall, and one span per batch.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import factories, telemetry
+from .admission import AdmissionController, RequestRejected
+from .batcher import DynamicBatcher, Request
+
+__all__ = ["Endpoint", "ServingEngine"]
+
+#: per-endpoint latency reservoir depth — enough for stable p99 under CI
+#: traffic without unbounded growth
+_LATENCY_SAMPLES = 512
+
+_LATENCIES: Dict[str, Deque[float]] = {}
+
+
+def _quantile(ordered: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile over an already-sorted sample."""
+    idx = min(len(ordered) - 1, max(0, int(math.ceil(q * len(ordered))) - 1))
+    return ordered[idx]
+
+
+def _latency_view() -> Dict[str, Dict[str, float]]:
+    out: Dict[str, Dict[str, float]] = {}
+    for name in sorted(_LATENCIES):
+        sample = sorted(_LATENCIES[name])
+        if sample:
+            out[name] = {
+                "count": len(sample),
+                "p50_s": round(_quantile(sample, 0.50), 6),
+                "p99_s": round(_quantile(sample, 0.99), 6),
+            }
+    return out
+
+
+_STATS = telemetry.register_group(
+    "serving",
+    {
+        "accepted": 0,
+        "rejected": 0,
+        "batched": 0,
+        "batches": 0,
+        "padded_rows": 0,
+        "step_compiles": 0,
+        "step_hits": 0,
+        "drains": 0,
+        "flush_cause": {"max_batch": 0, "timer": 0, "drain": 0},
+        "shed": {
+            "queue_full": 0,
+            "hbm_pressure": 0,
+            "stalled": 0,
+            "draining": 0,
+            "closed": 0,
+            "too_large": 0,
+        },
+    },
+    extra=lambda: {"latency": _latency_view()},
+    on_reset=_LATENCIES.clear,
+)
+
+
+def _mesh_size() -> int:
+    try:
+        from ..core import communication
+
+        return int(communication.world().size)
+    except Exception:
+        return 1
+
+
+def _pow2_buckets(min_bucket: int, max_batch: int) -> Tuple[int, ...]:
+    """Power-of-two ladder covering [min_bucket, max_batch] (both
+    rounded up to powers of two)."""
+    if min_bucket < 1 or max_batch < 1:
+        raise ValueError("min_bucket and max_batch must be >= 1")
+    size = 1 << (int(min_bucket) - 1).bit_length()
+    top = 1 << (int(max_batch) - 1).bit_length()
+    ladder = []
+    while size < top:
+        ladder.append(size)
+        size <<= 1
+    ladder.append(top)
+    return tuple(ladder)
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    """One registered predict surface with its frozen shape contract."""
+
+    name: str
+    predict: Callable[[Any], Any]
+    feature_dim: int
+    dtype: "np.dtype"
+    split: Optional[int]
+    buckets: Tuple[int, ...]
+    max_delay_s: float
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, rows: int) -> int:
+        for size in self.buckets:
+            if rows <= size:
+                return size
+        raise ValueError(f"{rows} rows exceed largest bucket {self.buckets[-1]}")
+
+
+class _Step:
+    """One cached compiled program: (endpoint, bucket) -> host fn."""
+
+    __slots__ = ("run", "fingerprint", "bucket")
+
+    def __init__(self, run: Callable[[np.ndarray], np.ndarray], fingerprint: str, bucket: int):
+        self.run = run
+        self.fingerprint = fingerprint
+        self.bucket = bucket
+
+
+class ServingEngine:
+    """The front door: endpoint registry, batcher, admission, steps.
+
+    Usable as a context manager (``with ServingEngine() as eng: ...``);
+    exit drains queued work then stops the worker."""
+
+    def __init__(
+        self,
+        *,
+        admission: Optional[AdmissionController] = None,
+        stall_detector=None,
+        default_max_delay_s: float = 0.005,
+    ):
+        self._endpoints: Dict[str, Endpoint] = {}
+        self._steps: Dict[Tuple[str, int], _Step] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self.default_max_delay_s = float(default_max_delay_s)
+        self.admission = admission if admission is not None else AdmissionController()
+        self._batcher = DynamicBatcher(self._execute)
+        self._detector = None
+        if stall_detector is not None:
+            self.attach_stall_detector(stall_detector)
+
+    # -- registry -------------------------------------------------------
+
+    def attach_stall_detector(self, detector):
+        """Wire a :class:`~heat_tpu.utils.fault.StallDetector` into the
+        admission gate (push-based shed) and beat it per served batch."""
+        self.admission.attach_stall_detector(detector)
+        self._detector = detector
+        return detector
+
+    def register(
+        self,
+        name: str,
+        model: Any = None,
+        *,
+        predict: Optional[Callable[[Any], Any]] = None,
+        feature_dim: int,
+        dtype: Any = np.float32,
+        split: Optional[int] = 0,
+        min_bucket: Optional[int] = None,
+        max_batch: int = 64,
+        max_delay_s: Optional[float] = None,
+        warm: bool = False,
+    ) -> Endpoint:
+        """Register an endpoint: exactly one of ``model`` (an object with
+        ``.predict``) or ``predict`` (a ``DNDarray -> DNDarray`` callable).
+
+        ``min_bucket`` defaults to ``max(8, mesh size)`` so split-0
+        batches always give every device at least one row; ``max_batch``
+        is rounded up to the bucket ladder's top rung.  ``warm=True``
+        compiles every bucket before the first request lands."""
+        if self._closed:
+            raise RuntimeError("serving engine is closed")
+        if (model is None) == (predict is None):
+            raise ValueError("pass exactly one of `model` or `predict`")
+        if predict is None:
+            predict = model.predict
+        if name in self._endpoints:
+            raise ValueError(f"endpoint {name!r} already registered")
+        feature_dim = int(feature_dim)
+        if feature_dim < 1:
+            raise ValueError(f"feature_dim must be >= 1, got {feature_dim}")
+        if min_bucket is None:
+            min_bucket = max(8, _mesh_size())
+        buckets = _pow2_buckets(min_bucket, max_batch)
+        endpoint = Endpoint(
+            name=name,
+            predict=predict,
+            feature_dim=feature_dim,
+            dtype=np.dtype(dtype),
+            split=split,
+            buckets=buckets,
+            max_delay_s=self.default_max_delay_s if max_delay_s is None else float(max_delay_s),
+        )
+        with self._lock:
+            self._endpoints[name] = endpoint
+        telemetry.record_event(
+            "serving_endpoint",
+            endpoint=name,
+            feature_dim=feature_dim,
+            buckets=list(buckets),
+            split=split,
+        )
+        if warm:
+            self.warmup(name)
+        return endpoint
+
+    def endpoints(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._endpoints))
+
+    def warmup(self, name: str) -> int:
+        """Compile + run every bucket of ``name`` once on zeros so live
+        traffic starts on warm caches.  Returns the bucket count."""
+        endpoint = self._endpoint(name)
+        for bucket in endpoint.buckets:
+            step = self._get_step(endpoint, bucket)
+            step.run(np.zeros((bucket, endpoint.feature_dim), dtype=endpoint.dtype))
+        return len(endpoint.buckets)
+
+    # -- request path ---------------------------------------------------
+
+    def submit(self, name: str, x: Any) -> Future:
+        """Admit + queue one request; resolves to the caller's rows only.
+
+        Raises :class:`~heat_tpu.serving.admission.RequestRejected` when
+        shed — the documented fast-fail, never a hang."""
+        endpoint = self._endpoint(name)
+        batch = np.asarray(x, dtype=endpoint.dtype)
+        if batch.ndim == 1:
+            batch = batch.reshape(1, -1)
+        if batch.ndim != 2 or batch.shape[1] != endpoint.feature_dim:
+            raise ValueError(
+                f"endpoint {name!r} serves (rows, {endpoint.feature_dim}) "
+                f"requests, got shape {np.shape(x)}"
+            )
+        rows = int(batch.shape[0])
+        if rows == 0:
+            raise ValueError("empty request")
+        try:
+            if self._closed:
+                raise RequestRejected("closed", None, "serving engine is closed")
+            if rows > endpoint.max_batch:
+                raise RequestRejected(
+                    "too_large",
+                    None,
+                    f"{rows} rows exceed endpoint max batch {endpoint.max_batch} "
+                    "(split oversized requests client-side)",
+                )
+            self.admission.admit(name, rows, batch.nbytes)
+        except RequestRejected as exc:
+            _STATS["rejected"] += 1
+            _STATS["shed"][exc.reason] += 1
+            telemetry.record_event(
+                "serving_shed",
+                endpoint=name,
+                reason=exc.reason,
+                rows=rows,
+                retry_after_s=exc.retry_after_s,
+            )
+            raise
+        _STATS["accepted"] += 1
+        now = time.perf_counter()
+        request = Request(
+            endpoint=name,
+            payload=batch,
+            rows=rows,
+            t0=now,
+            deadline=now + endpoint.max_delay_s,
+        )
+        self._batcher.enqueue(request, endpoint.max_batch)
+        return request.future
+
+    def predict(self, name: str, x: Any, timeout: Optional[float] = 30.0) -> np.ndarray:
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(name, x).result(timeout)
+
+    # -- batch execution (batcher worker thread) ------------------------
+
+    def _endpoint(self, name: str) -> Endpoint:
+        with self._lock:
+            endpoint = self._endpoints.get(name)
+        if endpoint is None:
+            raise KeyError(
+                f"unknown serving endpoint {name!r}; registered: {list(self.endpoints())}"
+            )
+        return endpoint
+
+    def _get_step(self, endpoint: Endpoint, bucket: int) -> _Step:
+        key = (endpoint.name, bucket)
+        with self._lock:
+            step = self._steps.get(key)
+            if step is not None:
+                _STATS["step_hits"] += 1
+                return step
+            _STATS["step_compiles"] += 1
+            fp = telemetry.fingerprint(
+                (
+                    "serving",
+                    endpoint.name,
+                    bucket,
+                    endpoint.feature_dim,
+                    str(endpoint.dtype),
+                    endpoint.split,
+                )
+            )
+            telemetry.ensure_program(
+                fp,
+                kind="serving_step",
+                endpoint=endpoint.name,
+                bucket=bucket,
+                feature_dim=endpoint.feature_dim,
+            )
+
+            def run(batch: np.ndarray, _ep: Endpoint = endpoint) -> np.ndarray:
+                x = factories.array(batch, split=_ep.split)
+                out = _ep.predict(x)
+                return out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+
+            step = _Step(run, fp, bucket)
+            self._steps[key] = step
+        telemetry.record_event(
+            "serving_compile", endpoint=endpoint.name, bucket=bucket, fingerprint=fp
+        )
+        return step
+
+    def _execute(self, name: str, requests: Sequence[Request], cause: str) -> None:
+        endpoint = self._endpoint(name)
+        rows = sum(r.rows for r in requests)
+        try:
+            bucket = endpoint.bucket_for(rows)
+            batch = np.zeros((bucket, endpoint.feature_dim), dtype=endpoint.dtype)
+            offset = 0
+            for request in requests:
+                batch[offset : offset + request.rows] = request.payload
+                offset += request.rows
+            _STATS["batches"] += 1
+            _STATS["padded_rows"] += bucket - rows
+            _STATS["flush_cause"][cause] += 1
+            step = self._get_step(endpoint, bucket)
+            with telemetry.span(
+                "serving.batch",
+                endpoint=name,
+                bucket=bucket,
+                rows=rows,
+                requests=len(requests),
+                cause=cause,
+            ):
+                t0 = time.perf_counter()
+                out = step.run(batch)
+                duration = time.perf_counter() - t0
+            telemetry.record_timing(step.fingerprint, duration)
+            telemetry.program_hit(step.fingerprint)
+        except BaseException as exc:  # noqa: BLE001 — every future must resolve
+            for request in requests:
+                try:
+                    request.future.set_exception(exc)
+                except InvalidStateError:
+                    pass
+            self.admission.release(rows)
+            telemetry.record_event("serving_error", endpoint=name, error=repr(exc))
+            return
+        offset = 0
+        done = time.perf_counter()
+        reservoir = _LATENCIES.setdefault(name, deque(maxlen=_LATENCY_SAMPLES))
+        for request in requests:
+            try:
+                request.future.set_result(out[offset : offset + request.rows])
+            except InvalidStateError:
+                pass
+            offset += request.rows
+            reservoir.append(done - request.t0)
+            _STATS["batched"] += 1
+        self.admission.release(rows)
+        self.admission.note_progress()
+        if self._detector is not None:
+            self._detector.beat()
+
+    # -- introspection / lifecycle --------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Live ``serving`` counter snapshot incl. latency percentiles."""
+        return telemetry.snapshot_group("serving")
+
+    def close(self, *, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful shutdown: shed new work, flush or cancel the queue,
+        stop the worker.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.admission.begin_drain()
+        drained = True
+        if drain:
+            drained = self._batcher.drain(timeout=timeout)
+        for request in self._batcher.cancel_pending():
+            try:
+                request.future.set_exception(
+                    RequestRejected("closed", None, "engine closed before execution")
+                )
+            except InvalidStateError:
+                pass
+            self.admission.release(request.rows)
+        self._batcher.stop()
+        self.admission.close()
+        self._detector = None
+        _STATS["drains"] += 1
+        telemetry.record_event(
+            "serving_drain", drained=bool(drained), endpoints=len(self._endpoints)
+        )
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

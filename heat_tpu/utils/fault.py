@@ -253,16 +253,29 @@ class StallDetector:
 
     >>> with watchdog.pause():
     ...     out = chain.materialize()   # long XLA compile, no heartbeat
+
+    :meth:`subscribe` registers push callbacks ``cb(kind, info)`` with
+    kind ∈ ``{"stall", "recover", "pause", "resume"}`` — the serving
+    admission gate rides this instead of polling.  ``"recover"`` fires
+    on the first beat after a stall fired.  Callbacks run on whichever
+    thread triggered the transition (watchdog thread for ``"stall"``)
+    and are dispatched from a snapshot taken under the lock, so a
+    subscriber may unsubscribe itself (or others) mid-dispatch.
     """
 
-    def __init__(self, timeout: float, on_stall: Callable[[float], None]):
+    def __init__(self, timeout: float, on_stall: Optional[Callable[[float], None]] = None):
         self.timeout = float(timeout)
         self.on_stall = on_stall
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._fired = False
         self._paused = 0
+        # one lock for ALL of _last/_fired/_paused/_subs: beat() and the
+        # watcher's check-and-fire used to race unlocked, so a beat
+        # landing between the quiet check and `_fired = True` could be
+        # swallowed by a stale stall (pinned in tests/test_fault.py)
         self._pause_lock = threading.Lock()
+        self._subs: List[Callable[[str, dict], None]] = []
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "StallDetector":
@@ -271,12 +284,44 @@ class StallDetector:
         self._thread.start()
         return self
 
+    def subscribe(self, callback: Callable[[str, dict], None]) -> Callable[[str, dict], None]:
+        """Register ``callback(kind, info)`` for stall-plane transitions."""
+        with self._pause_lock:
+            if callback not in self._subs:
+                self._subs.append(callback)
+        return callback
+
+    def unsubscribe(self, callback: Callable[[str, dict], None]) -> None:
+        """Remove a subscriber; unknown callbacks are a no-op."""
+        with self._pause_lock:
+            try:
+                self._subs.remove(callback)
+            except ValueError:
+                pass
+
+    def _notify(self, kind: str, **info) -> None:
+        # snapshot under the lock, dispatch outside it: subscribers may
+        # re-enter subscribe/unsubscribe (or beat()) without deadlock
+        with self._pause_lock:
+            subs = tuple(self._subs)
+        for callback in subs:
+            try:
+                callback(kind, dict(info))
+            except Exception as exc:  # noqa: BLE001 — watchdog must survive
+                telemetry.record_event(
+                    "stall_subscriber_error", kind=kind, error=repr(exc)
+                )
+
     def beat(self) -> None:
-        self._last = time.monotonic()
-        self._fired = False
+        with self._pause_lock:
+            recovered = self._fired
+            self._last = time.monotonic()
+            self._fired = False
         # a stall postmortem reads the last heartbeats (and the spans
         # open around them) straight out of the flight recorder
         telemetry.record_event("heartbeat")
+        if recovered:
+            self._notify("recover", timeout_s=self.timeout)
 
     def stop(self) -> None:
         self._stop.set()
@@ -289,7 +334,9 @@ class StallDetector:
         yourself for the standalone form."""
         with self._pause_lock:
             self._paused += 1
-        telemetry.record_event("stall_pause", depth=self._paused)
+            depth = self._paused
+        telemetry.record_event("stall_pause", depth=depth)
+        self._notify("pause", depth=depth)
         return _StallPause(self)
 
     def resume(self) -> None:
@@ -297,32 +344,41 @@ class StallDetector:
         lifts, so paused time never counts as quiet time."""
         with self._pause_lock:
             # re-arm the clock *before* lifting the pause flag: the watch
-            # thread reads these unlocked, and must never pair a lifted
-            # flag with a stale _last from before the pause
+            # thread must never pair a lifted flag with a stale _last
+            # from before the pause
             self._last = time.monotonic()
             self._fired = False
             self._paused = max(0, self._paused - 1)
-        telemetry.record_event("stall_resume", depth=self._paused)
+            depth = self._paused
+        telemetry.record_event("stall_resume", depth=depth)
+        self._notify("resume", depth=depth)
 
     def _watch(self) -> None:
         poll = min(0.05, self.timeout / 4)
         while not self._stop.wait(poll):
-            if self._paused:
-                continue
-            quiet = time.monotonic() - self._last
-            if quiet > self.timeout and not self._fired:
+            with self._pause_lock:
+                # check-and-fire under the same lock beat() writes under:
+                # a concurrent beat either lands before the check (no
+                # fire) or after the fire (a "recover"), never in between
+                if self._paused:
+                    continue
+                quiet = time.monotonic() - self._last
+                if quiet <= self.timeout or self._fired:
+                    continue
                 self._fired = True  # once per stall, not once per poll
-                # recorded from the watchdog thread: open_spans() reaches
-                # across threads, so the event names what the workload had
-                # in flight when it went quiet
-                telemetry.record_event(
-                    "stall",
-                    quiet_s=round(quiet, 3),
-                    timeout_s=self.timeout,
-                    open_spans=telemetry.open_spans(),
-                )
-                telemetry.postmortem("stall")
+            # recorded from the watchdog thread: open_spans() reaches
+            # across threads, so the event names what the workload had
+            # in flight when it went quiet
+            telemetry.record_event(
+                "stall",
+                quiet_s=round(quiet, 3),
+                timeout_s=self.timeout,
+                open_spans=telemetry.open_spans(),
+            )
+            telemetry.postmortem("stall")
+            if self.on_stall is not None:
                 self.on_stall(quiet)
+            self._notify("stall", quiet_s=round(quiet, 3), timeout_s=self.timeout)
 
 
 class _StallPause:

@@ -71,6 +71,21 @@
 #                 with an honest measured-arm field and its Prometheus
 #                 export must parse — and the perf-regression gate rerun
 #                 with the kernel arms enabled
+#  17. analyzer  — SPMD hazard analyzer (ISSUE 13): the lint gate on the
+#                 shipped tree, the three-tier analysis laws at meshes
+#                 8/4/1, and a live planted use-after-donate caught by
+#                 the runtime sanitizer with full attribution
+#  18. serving   — batch-serving front door (ISSUE 14): the serving test
+#                 file at meshes 8/4/1 (bucket ladder, no-retrace law,
+#                 admission shed reasons incl. injected-stall fast-fail,
+#                 drain), then a live two-process warm-started serve —
+#                 process 1 serves traffic while the tuning plane
+#                 explores and persists its table, the merge CLI folds
+#                 it into a fleet cache, process 2 warm-starts from the
+#                 merged file and serves the same buckets with ZERO
+#                 explores and ZERO new compiles after warmup — and the
+#                 cb serving_batch row under the regression gate
+#                 (batched >= 2x sequential, shed/drain exercised)
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -83,7 +98,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/17 suite (8-device mesh)"
+say "1/18 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -92,21 +107,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/17 core subset (4-device mesh)"
+say "2/18 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/17 parity audit (exits nonzero on any gap)"
+say "3/18 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/17 multi-chip dry-run"
+say "4/18 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/17 cb smoke"
+say "5/18 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -115,10 +130,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/17 copycheck"
+say "6/18 copycheck"
 python scripts/copycheck.py
 
-say "7/17 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/18 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -134,10 +149,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/17 fusion retrace guard (second call must hit the compile cache)"
+say "8/18 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/17 guardrails (fault injection + strict-guard retrace check)"
+say "9/18 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -148,7 +163,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/17 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/18 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -156,13 +171,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/17 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/18 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/17 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/18 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -193,7 +208,7 @@ for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/17 roofline attribution + perf-regression gate"
+say "13/18 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -242,7 +257,7 @@ print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
-say "14/17 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+say "14/18 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
 # the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
 # live end-to-end forensics check: census-bearing postmortem, informed
 # first retry from measured free HBM, and the memory counter track
@@ -307,7 +322,7 @@ print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
       f"bytes, {len(counters)} counter samples")
 EOF
 
-say "15/17 autotune (explore/exploit laws + live two-process warm start)"
+say "15/18 autotune (explore/exploit laws + live two-process warm start)"
 # the self-tuning-runtime contracts (ISSUE 11) at three mesh sizes, then a
 # live warm-start check: process 1 explores, resolves winners and saves its
 # table; process 2 loads the cache at import and must do ZERO explores —
@@ -391,7 +406,7 @@ assert not reg["regressions"], \
 print(f"autotuned check-regression OK: {len(reg['rows'])} rows judged")
 EOF
 
-say "16/17 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
+say "16/18 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
 # the kernel-tier contracts (ISSUE 12) at three mesh sizes: each test
 # scopes HEAT_TPU_PALLAS=interpret itself, so plain pytest runs suffice —
 # repack bit-exactness (incl. the pad-lane regression), fused QR panel vs
@@ -441,7 +456,7 @@ print(f"cb kernels OK: {len(rows)} rows (arms={sorted(arms)}), "
       f"{len(reg['rows'])} judged, {len(samples)} gauges")
 EOF
 
-say "17/17 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
+say "17/18 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
 # the static gate: the shipped tree must self-check clean — every
 # residual finding either fixed, inline-justified (# ht: HTxxx ok), or
 # carried in analysis/baseline.json with a human reason
@@ -478,5 +493,121 @@ except UseAfterDonateError as err:
 else:
     raise SystemExit("planted use-after-donate was NOT caught")
 EOF_SAN
+
+say "18/18 serving front door (bucketed batching laws + live warm-started serve, meshes 8/4/1)"
+# the serving contracts (ISSUE 14) at three mesh sizes: bucket ladder,
+# the no-retrace law under mixed concurrent traffic, every admission
+# shed reason including the injected-stall fast-fail, drain semantics,
+# and the latency/Prometheus surface
+python -m pytest -q -p no:cacheprovider \
+  tests/test_serving.py 2>&1 | tee /tmp/ci_serving.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_serving.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_serving.py
+# live two-process warm-started serving: process 1 serves bucketed
+# traffic with the tuning plane exploring (fusion off so the eager
+# matmul endpoint IS the explore site) and persists its table; the
+# merge CLI folds it into a fleet cache; process 2 warm-starts from the
+# merged file and must serve the same buckets with ZERO explores and
+# ZERO new step compiles / overlap builds after its warmup pass
+rm -f /tmp/ci_serving_cache.json /tmp/ci_serving_merged.json
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEAT_TPU_AUTOTUNE=on HEAT_TPU_FUSE=0 HEAT_TPU_TELEMETRY=events \
+python - <<'EOF'
+import numpy as np
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.core import autotune, telemetry
+
+rng = np.random.default_rng(14)
+w_np = rng.random((512, 1024)).astype(np.float32)
+w = ht.array(w_np, split=0)
+eng = serving.ServingEngine()
+eng.register(
+    "mm", predict=lambda x: ht.matmul(x, w), feature_dim=512,
+    min_bucket=64, max_batch=256, max_delay_s=0.005, warm=True,
+)
+for bucket in (64, 128, 256):
+    x = rng.random((bucket, 512)).astype(np.float32)
+    want = x @ w_np
+    for _ in range(autotune.explore_k() + 2):
+        got = np.asarray(eng.predict("mm", x, timeout=120))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+eng.close()
+
+st = autotune.stats()
+assert st["explores"] >= 3 * autotune.explore_k(), st
+rows = autotune.report()["rows"]
+assert len(rows) == 3 and all(r["winner"] for r in rows), rows
+n = autotune.save("/tmp/ci_serving_cache.json")
+assert n == 3, n
+sv = telemetry.serving_report()
+assert sv["step_compiles"] == 3 and sv["rejected"] == 0, sv
+print(f"serve process 1: {st['explores']} explores over 3 buckets, "
+      f"{n} winners persisted ({[r['winner'] for r in rows]})")
+EOF
+# fleet merge: the CLI must fold per-process caches (here: the same one
+# twice) into one warm-start file load() accepts
+python -m heat_tpu.core.autotune \
+  --merge /tmp/ci_serving_cache.json /tmp/ci_serving_cache.json \
+  --out /tmp/ci_serving_merged.json
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEAT_TPU_AUTOTUNE=on HEAT_TPU_FUSE=0 HEAT_TPU_TELEMETRY=events \
+HEAT_TPU_AUTOTUNE_CACHE=/tmp/ci_serving_merged.json \
+python - <<'EOF'
+import numpy as np
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.core import autotune, telemetry
+
+rng = np.random.default_rng(14)
+w_np = rng.random((512, 1024)).astype(np.float32)
+w = ht.array(w_np, split=0)
+eng = serving.ServingEngine()
+eng.register(
+    "mm", predict=lambda x: ht.matmul(x, w), feature_dim=512,
+    min_bucket=64, max_batch=256, max_delay_s=0.005, warm=True,
+)
+# warmup done: steady traffic over the same buckets must add NOTHING
+steps_before = telemetry.serving_report()["step_compiles"]
+ring_before = telemetry.snapshot_group("overlap").get("ring_builds", 0)
+for bucket in (64, 128, 256):
+    x = rng.random((bucket, 512)).astype(np.float32)
+    want = x @ w_np
+    for _ in range(3):
+        got = np.asarray(eng.predict("mm", x, timeout=120))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+eng.close()
+
+st = autotune.stats()
+assert st["explores"] == 0, f"warm serve explored: {st}"
+assert st["cache_loads"] == 3, st
+decisions = [e for e in telemetry.events() if e["kind"] == "autotune_decision"]
+assert decisions and all(e["source"] == "cached" for e in decisions), decisions
+sv = telemetry.serving_report()
+assert sv["step_compiles"] == steps_before == 3, sv
+assert telemetry.snapshot_group("overlap").get("ring_builds", 0) == ring_before, \
+    "steady bucketed traffic rebuilt overlap programs"
+print(f"serve process 2: zero explores, {sv['batches']} batches served "
+      f"from the merged warm cache with zero new compiles")
+EOF
+# the cb serving row under the regression gate: batched must beat
+# sequential single-request predict >= 2x on this mesh, with the shed
+# and drain paths exercised inside the same workload
+( cd benchmarks/cb && python main.py \
+  --only serving --check-regression --out /tmp/ci_cb_serving.json )
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/ci_cb_serving.json"))
+(row,) = [m for m in doc["measurements"] if m["name"] == "serving_batch"]
+assert row["speedup"] >= 2.0, f"batched front door under 2x: {row}"
+assert row["sheds"] >= 1, f"injected-stall shed path did not run: {row}"
+assert row["drain_flushes"] >= 1, f"drain path did not flush: {row}"
+assert any(r["name"] == "serving_batch" for r in doc["regression"]["rows"])
+print(f"cb serving_batch OK: {row['speedup']}x batched vs sequential, "
+      f"p99 {row['p99_ms']} ms, {row['sheds']} sheds, "
+      f"{row['drain_flushes']} drain flushes")
+EOF
 
 say "CI GREEN"

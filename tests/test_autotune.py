@@ -521,5 +521,95 @@ class TestOpsSurface(TestCase):
             del os.environ["HEAT_TPU_AUTOTUNE_EXPLORE"]
 
 
+class TestMerge(TestCase):
+    """`autotune.merge` (ISSUE 14 satellite): fleet caches fold into one
+    warm-start file, newest-best per (fingerprint, device kind, arms),
+    refusing whole files that `load` would refuse."""
+
+    @staticmethod
+    def _doc(entries, library=None):
+        return {
+            "version": autotune.CACHE_VERSION,
+            "library": ht.__version__ if library is None else library,
+            "entries": entries,
+        }
+
+    @staticmethod
+    def _entry(fp, winner, best, arms=None):
+        arms = arms or {"ring": [best or 0.01], "gspmd": [0.05]}
+        return {"fingerprint": fp, "device_kind": "cpu", "winner": winner,
+                "best_s": best, "desc": "d", "arms": arms}
+
+    def test_newest_best_selection(self):
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            p1, p2, out = (os.path.join(td, n) for n in ("a.json", "b.json", "m.json"))
+            # p1: slower resolved winner for fp_x + an unresolved fp_y
+            json.dump(self._doc([
+                self._entry("fp_x", "ring", 0.02),
+                self._entry("fp_y", None, None, {"classic": [0.5], "kernel": []}),
+            ]), open(p1, "w"))
+            # p2 (newer): faster winner for fp_x, resolved fp_y
+            json.dump(self._doc([
+                self._entry("fp_x", "gspmd", 0.01,
+                            {"ring": [0.03], "gspmd": [0.01]}),
+                self._entry("fp_y", "kernel", 0.1,
+                            {"classic": [0.5], "kernel": [0.1]}),
+            ]), open(p2, "w"))
+            self.assertEqual(autotune.merge([p1, p2], out), out)
+            doc = json.load(open(out))
+            self.assertEqual(doc["version"], autotune.CACHE_VERSION)
+            self.assertEqual(doc["library"], ht.__version__)
+            got = {e["fingerprint"]: e for e in doc["entries"]}
+            self.assertEqual(len(got), 2)
+            # lower best_s wins regardless of order...
+            self.assertEqual(got["fp_x"]["winner"], "gspmd")
+            self.assertEqual(got["fp_x"]["best_s"], 0.01)
+            # ...and resolved beats unresolved
+            self.assertEqual(got["fp_y"]["winner"], "kernel")
+            # the merged file round-trips through load
+            autotune.reset()
+            self.assertEqual(autotune.load(out), 2)
+            self.assertEqual(autotune.winner(("fp_x", "cpu")), "gspmd")
+
+    def test_ties_go_to_the_later_path(self):
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            p1, p2, out = (os.path.join(td, n) for n in ("a.json", "b.json", "m.json"))
+            json.dump(self._doc([self._entry("fp", "ring", 0.01)]), open(p1, "w"))
+            newer = self._entry("fp", "ring", 0.01)
+            newer["desc"] = "newest"
+            json.dump(self._doc([newer]), open(p2, "w"))
+            autotune.merge([p1, p2], out)
+            (entry,) = json.load(open(out))["entries"]
+            self.assertEqual(entry["desc"], "newest")
+
+    def test_cross_library_rows_refused_whole_file(self):
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            good = os.path.join(td, "good.json")
+            alien = os.path.join(td, "alien.json")
+            broken = os.path.join(td, "broken.json")
+            out = os.path.join(td, "m.json")
+            json.dump(self._doc([self._entry("fp_ok", "ring", 0.01)]), open(good, "w"))
+            json.dump(self._doc([self._entry("fp_alien", "ring", 0.001)],
+                                library="9.9.9"), open(alien, "w"))
+            with open(broken, "w") as f:
+                f.write("{nope")
+            autotune.merge([alien, good, broken], out)
+            doc = json.load(open(out))
+            self.assertEqual([e["fingerprint"] for e in doc["entries"]], ["fp_ok"])
+            self.assertEqual(autotune.stats()["fallbacks"], 2)
+            evs = [e for e in telemetry.events()
+                   if e["kind"] == "fallback" and e.get("site") == "autotune.merge"]
+            self.assertEqual(len(evs), 2)
+
+    def test_cli_entry_point(self):
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            p1 = os.path.join(td, "a.json")
+            out = os.path.join(td, "m.json")
+            json.dump(self._doc([self._entry("fp", "ring", 0.01)]), open(p1, "w"))
+            rc = autotune._main(["--merge", p1, p1, "--out", out])
+            self.assertEqual(rc, 0)
+            self.assertEqual(len(json.load(open(out))["entries"]), 1)
+
+
 if __name__ == "__main__":
     unittest.main()

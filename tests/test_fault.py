@@ -7,6 +7,7 @@ Orbax restore path — no mocks (the reference's test doctrine, SURVEY.md §4).
 """
 
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -271,6 +272,108 @@ class TestStallDetector(TestCase):
             det.resume()
             time.sleep(0.5)  # fully resumed -> quiet time counts again
             self.assertEqual(len(stalls), 1)
+        finally:
+            det.stop()
+
+
+class TestStallSubscribers(TestCase):
+    """The push hook (ISSUE 14 satellite): stall/pause/resume/recover
+    notifications, and the thread-safety laws the hook exposed."""
+
+    def test_stall_and_recover_notifications_without_on_stall(self):
+        from heat_tpu.utils.fault import StallDetector
+
+        events = []
+        det = StallDetector(timeout=0.1).start()  # on_stall now optional
+        det.subscribe(lambda kind, info: events.append((kind, info)))
+        try:
+            time.sleep(0.35)  # quiet -> stall
+            kinds = [k for k, _ in events]
+            self.assertEqual(kinds, ["stall"])
+            self.assertGreater(events[0][1]["quiet_s"], 0.1)
+            det.beat()  # first beat after a fired stall -> recover
+            time.sleep(0.05)
+            self.assertEqual([k for k, _ in events], ["stall", "recover"])
+        finally:
+            det.stop()
+
+    def test_pause_resume_notifications_with_depth(self):
+        from heat_tpu.utils.fault import StallDetector
+
+        events = []
+        det = StallDetector(timeout=5.0)
+        det.subscribe(lambda kind, info: events.append((kind, info["depth"])))
+        with det.pause():
+            det.pause()
+            det.resume()
+        self.assertEqual(
+            events, [("pause", 1), ("pause", 2), ("resume", 1), ("resume", 0)]
+        )
+
+    def test_unsubscribe_during_dispatch_does_not_skip_peers(self):
+        # THE latent-bug pin: dispatch used to iterate the live list, so
+        # a subscriber removing itself shifted its peer out from under
+        # the iterator and the peer silently missed the event.  Dispatch
+        # now walks a snapshot taken under the lock.
+        from heat_tpu.utils.fault import StallDetector
+
+        det = StallDetector(timeout=5.0)
+        seen_a, seen_b = [], []
+
+        def sub_a(kind, info):
+            seen_a.append(kind)
+            det.unsubscribe(sub_a)  # mutates the list mid-dispatch
+
+        det.subscribe(sub_a)
+        det.subscribe(lambda kind, info: seen_b.append(kind))
+        det.pause()   # both must see this, despite sub_a self-removing
+        det.resume()  # only the lambda remains
+        self.assertEqual(seen_a, ["pause"])
+        self.assertEqual(seen_b, ["pause", "resume"])
+
+    def test_subscriber_exception_never_kills_the_watchdog(self):
+        from heat_tpu.utils.fault import StallDetector
+
+        stalls = []
+        det = StallDetector(timeout=0.1, on_stall=stalls.append).start()
+
+        def bad(kind, info):
+            raise RuntimeError("subscriber bug")
+
+        det.subscribe(bad)
+        try:
+            time.sleep(0.35)
+            self.assertEqual(len(stalls), 1)  # fired despite the bad sub
+            det.beat()
+            time.sleep(0.35)
+            self.assertEqual(len(stalls), 2)  # watchdog thread survived
+        finally:
+            det.stop()
+
+    def test_beat_storm_never_false_stalls(self):
+        # pins the locking fix: beat() writes and the watcher's
+        # check-and-fire now share one lock, so a beat can never land
+        # between the quiet-check and the fire and be swallowed by a
+        # stale stall
+        from heat_tpu.utils.fault import StallDetector
+
+        events = []
+        det = StallDetector(timeout=0.15).start()
+        det.subscribe(lambda kind, info: events.append(kind))
+        stop = time.monotonic() + 0.6
+
+        def hammer():
+            while time.monotonic() < stop:
+                det.beat()
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            self.assertNotIn("stall", events)
         finally:
             det.stop()
 
