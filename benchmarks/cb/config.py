@@ -91,6 +91,10 @@ def matmul_flops(n: int) -> float:
     return 2.0 * n**3
 
 
+def matmul_flops_mkn(m: int, k: int, n: int) -> float:
+    return 2.0 * m * k * n
+
+
 def attention_flops(bh: int, s: int, d: int, causal: bool = True) -> float:
     """4*bh*s^2*d (QK^T + PV at 2 FLOPs/MAC), halved for causal masking."""
     full = 4.0 * bh * s * s * d
@@ -190,3 +194,10 @@ RESNET_BATCH, RESNET_IMG = (256, 224) if ON_TPU else (8, 32)
 # so the CPU row finishes in seconds while still coalescing real batches
 SERVING_F, SERVING_K = (64, 8) if ON_TPU else (32, 8)
 SERVING_REQS = 256 if ON_TPU else 96
+# quantized-epilogue rows (round 16): the int8 weight path through the
+# tuned dispatch; sized so the CPU explore (both arms in the timed
+# region) stays in seconds while the weight is big enough that the
+# residency columns mean something
+QLINEAR_M, QLINEAR_K, QLINEAR_N = (8_192, 8_192, 8_192) if ON_TPU else (256, 512, 256)
+QKNN_N, QKNN_F = (65_536, 64) if ON_TPU else (2_048, 32)
+QKNN_REQS = 128 if ON_TPU else 48

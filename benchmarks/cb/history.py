@@ -67,6 +67,17 @@ TOLERANCE = {
     # the batcher worker on a CPU CI mesh), so run-to-run spread is
     # scheduler noise, not kernel time
     "serving_batch": 0.5,
+    # round-16 quantized rows (quantize.py's own notes): measured from a
+    # COLD tuning table like the kernel-tier rows — the timed region
+    # includes the explore phase running BOTH arms back to back, and on
+    # the CPU CI mesh which arm wins is scheduler-dependent (no int8 MXU
+    # path; the win the rows vouch for is the exact-ledger residency
+    # columns, which the ci.sh stage-19 gate checks separately)
+    "linear_int8": 0.5,
+    "moe_ffn_int8": 0.5,
+    # single-run batched wall over a thread pool, same contract as
+    # serving_batch: Python thread scheduling rides the number
+    "serving_knn": 0.5,
 }
 
 _ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")

@@ -16,6 +16,7 @@ import kernels
 import linalg
 import manipulations
 import nn
+import quantize
 import regression
 import serving
 
@@ -91,7 +92,7 @@ if __name__ == "__main__":
         default=None,
         help="comma-separated subset: "
              "linalg,cluster,manipulations,nn,regression,fusion,kernels,"
-             "serving",
+             "serving,quantize",
     )
     ap.add_argument(
         "--check-regression",
@@ -110,6 +111,7 @@ if __name__ == "__main__":
         "kernels": kernels.run,
         "manipulations": manipulations.run,
         "nn": nn.run,
+        "quantize": quantize.run,
         "regression": regression.run,
         "serving": serving.run,
     }

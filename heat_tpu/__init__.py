@@ -27,6 +27,7 @@ from .core import (
     manipulations,
     memory,
     printing,
+    quantize,
     random,
     relational,
     rounding,

@@ -23,7 +23,10 @@ this repo has already paid for once:
 * **HT005** — ``jax.jit(..., donate_argnums=...)`` where the donated
   Python name is loaded again after the call: use-after-donate is
   silent corruption on TPU (and silently *works* on CPU, which is how
-  it survives CI).
+  it survives CI).  ``quantize_weights(w, ..., donate=True)`` counts as
+  a donation of ``w`` too — it consumes the master through a
+  donate_argnums dispatch (core/quantize.py) and poisons it for the
+  runtime sanitizer.
 
 Suppression: append ``# ht: HT00x ok — <reason>`` to the flagged line.
 Residual findings live in ``baseline.json`` next to this file; every
@@ -456,6 +459,22 @@ def _rule_ht005(tree: ast.Module, ctx: _Ctx) -> List[Finding]:
                             donated.setdefault(
                                 node.args[p].id, node.lineno
                             )
+            if (
+                isinstance(node, ast.Call)
+                and _dotted(node.func).endswith("quantize_weights")
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and any(
+                    kw.arg == "donate"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords
+                )
+            ):
+                # quantize_weights(w, ..., donate=True) consumes the
+                # master exactly like a donate_argnums dispatch (and
+                # poisons it for the runtime sanitizer)
+                donated.setdefault(node.args[0].id, node.lineno)
         if not donated:
             continue
         rebound: Dict[str, int] = {}

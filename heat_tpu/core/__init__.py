@@ -80,3 +80,4 @@ from . import tiling
 from .tiling import *
 from . import linalg
 from .linalg import *
+from . import quantize

@@ -71,6 +71,8 @@ __all__ = [
     "note_budget_seed",
     "note_prior",
     "observe",
+    "QUANT_ARMS",
+    "quant_key",
     "report",
     "reset",
     "salt",
@@ -86,9 +88,16 @@ ARMS = ("ring", "gspmd")
 # "classic" is whatever the site dispatched before this round (ROADMAP
 # item 2 predicted exactly this extension)
 KERNEL_ARMS = ("classic", "kernel")
+# round 16: quantized inference epilogues (core/quantize.py) — "bf16" is
+# the dequantize-then-dispatch reference (bitwise the unquantized flow
+# over the same dequantized values), "int8" keeps the low-precision
+# buffer through the GEMM with the per-channel scale folded into the
+# ring epilogue.  The reference arm name stays "bf16" for fp8 entries
+# too: the arm names the REFERENCE precision class, not the storage.
+QUANT_ARMS = ("bf16", "int8")
 # every arm name any entry may carry; load() refuses winners outside it
 # so a corrupt cache cannot inject an undispatched arm
-_KNOWN_ARMS = frozenset(ARMS) | frozenset(KERNEL_ARMS)
+_KNOWN_ARMS = frozenset(ARMS) | frozenset(KERNEL_ARMS) | frozenset(QUANT_ARMS)
 CACHE_VERSION = 1
 
 # samples kept per arm (min_s over a bounded window; enough for the
@@ -323,6 +332,17 @@ def kernel_key(site: str, *geometry) -> Tuple[str, str]:
     pre-round-15 lowering) vs "kernel" (the Pallas arm); both are
     measured by the same explore/exploit machinery as ring-vs-GSPMD."""
     fp = telemetry.fingerprint(("kernel", site) + tuple(geometry))
+    return fp, device_kind()
+
+
+def quant_key(site: str, *geometry) -> Tuple[str, str]:
+    """Tuning-table key for one quantized-weight dispatch site
+    (``linear`` / ``moe_ffn`` — core/quantize.py) at one geometry.  The
+    entry's arms are :data:`QUANT_ARMS`: "bf16" (dequantize the weight,
+    then the ordinary tuned matmul — the reference arm explore returns)
+    vs "int8" (the low-precision buffer rides the GEMM, per-channel
+    scales fold into the ring epilogue as runtime extras)."""
+    fp = telemetry.fingerprint(("quant", site) + tuple(geometry))
     return fp, device_kind()
 
 

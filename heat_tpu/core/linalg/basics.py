@@ -52,7 +52,15 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
 
     The output split follows the reference's case table: split-0 ``a`` keeps
     the row partition, split-1 ``b`` keeps the column partition, inner splits
-    reduce away."""
+    reduce away.
+
+    A quantized right operand (``ht.quantize.quantize_weights``) routes to
+    the quantized GEMM — per-channel dequant folded into the ring epilogue,
+    dispatch tuned as ``("bf16","int8")`` autotune arms."""
+    from .. import quantize
+
+    if isinstance(b, quantize.QuantizedDNDarray):
+        return quantize.matmul_quantized(a, b)
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
     if a.ndim >= 1 and b.ndim >= 1:

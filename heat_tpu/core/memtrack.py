@@ -129,13 +129,19 @@ def summary() -> dict:
     ledger high-water mark, a per-tag bytes breakdown, and the sampled
     per-device peaks."""
     by_tag: Dict[str, int] = {}
+    by_dtype: Dict[str, int] = {}
     for rec in _LEDGER.values():
         by_tag[rec["tag"]] = by_tag.get(rec["tag"], 0) + rec["nbytes"]
+        dt = str(rec["dtype"])
+        by_dtype[dt] = by_dtype.get(dt, 0) + rec["nbytes"]
     return {
         "live_buffers": len(_LEDGER),
         "live_bytes": _LIVE_BYTES[0],
         "peak_live_bytes": _PEAK_LIVE[0],
         "bytes_by_tag": by_tag,
+        # per-dtype residency: the one-snapshot answer to "what did
+        # quantizing the weights actually buy" (int8 vs f32/bf16 bytes)
+        "bytes_by_dtype": by_dtype,
         "device_peak_bytes": dict(_DEVICE_PEAKS),
     }
 
@@ -292,6 +298,7 @@ def census(top: int = 8) -> dict:
     return {
         "live_buffers": len(_LEDGER),
         "live_bytes": _LIVE_BYTES[0],
+        "bytes_by_dtype": summary()["bytes_by_dtype"],
         "top": live_buffers(top),
     }
 

@@ -371,6 +371,13 @@ def _mem_prom_lines(lines: List[str]) -> None:
         lines.append(f"# TYPE {name} gauge")
         for dev, val in peaks.items():
             lines.append(f'{name}{{device="{_label_escape(dev)}"}} {val}')
+    if s.get("bytes_by_dtype"):
+        name = "heat_tpu_mem_bytes_by_dtype"
+        lines.append(f"# HELP {name} heat_tpu telemetry gauge ledgered "
+                     f"live bytes per buffer dtype")
+        lines.append(f"# TYPE {name} gauge")
+        for dt, val in sorted(s["bytes_by_dtype"].items()):
+            lines.append(f'{name}{{dtype="{_label_escape(dt)}"}} {val}')
 
 
 def export_prometheus() -> str:

@@ -66,6 +66,15 @@ class MoEMlp(nn.Module):
     ``intermediates/moe_aux_loss`` — pull it out with
     ``model.apply(vars, x, mutable=["intermediates"])`` and add
     ``alpha * sum(losses)`` to the training objective.
+
+    ``quantize="int8"``/``"fp8"`` quantizes the expert weights per
+    (expert, out-channel) at call time before the FFN — the int8 buffers
+    feed the expert GEMMs, with bf16-vs-int8 arm dispatch handled by the
+    tuning plane.  This call-time form keeps flax's param tree intact
+    (``apply`` shape-checks params, so a ``QuantizedTensor`` cannot be
+    STORED there); the steady-state HBM-residency win belongs to the
+    serving path, which quantizes once via ``quantize_params`` and calls
+    the functional ``moe_ffn`` directly.
     """
 
     num_experts: int
@@ -74,9 +83,11 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 2.0
     ep_mesh: Optional[object] = None
     ep_axis: str = "ep"
+    quantize: Optional[str] = None  # None | "int8" | "fp8"
 
     @nn.compact
     def __call__(self, x):
+        from ..core import quantize as quantize_mod
         from ..parallel.expert import moe_ffn
 
         d = x.shape[-1]
@@ -84,6 +95,13 @@ class MoEMlp(nn.Module):
         gate_w = self.param("gate", init, (d, self.num_experts))
         w_in = self.param("w_in", init, (self.num_experts, d, self.hidden))
         w_out = self.param("w_out", init, (self.num_experts, self.hidden, d))
+        if self.quantize is not None:
+            w_in = quantize_mod.quantize_tensor(
+                w_in, self.quantize, axis=(0, 2)
+            )
+            w_out = quantize_mod.quantize_tensor(
+                w_out, self.quantize, axis=(0, 2)
+            )
         y, aux = moe_ffn(
             x, gate_w, w_in, w_out,
             k=self.k, capacity_factor=self.capacity_factor,

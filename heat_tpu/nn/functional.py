@@ -21,9 +21,16 @@ def linear(input, weight, bias=None):
     Routed through the heat ops rather than raw jnp so the fusion engine
     captures the chain: with the engine on, the matmul terminates a lazy
     chain and the bias add rides into the ring program as a fused epilogue
-    (heat_tpu/parallel/overlap.py) instead of a second sharded pass."""
+    (heat_tpu/parallel/overlap.py) instead of a second sharded pass.
+
+    A quantized weight (``ht.quantize.quantize_weights``) takes the
+    quantized GEMM instead — per-channel dequant folded into the ring
+    epilogue, dispatch tuned as ``("bf16","int8")`` autotune arms."""
+    from ..core import quantize
     from ..core.linalg import basics
 
+    if isinstance(weight, quantize.QuantizedDNDarray):
+        return quantize.linear(input, weight, bias)
     out = basics.matmul(input, basics.transpose(weight))
     if bias is not None:
         out = out + bias

@@ -118,6 +118,8 @@ def _moe_shard(
     gate_w,
     w_in,
     w_out,
+    s_in=None,
+    s_out=None,
     *,
     k: int,
     capacity: int,
@@ -125,7 +127,14 @@ def _moe_shard(
     axis: Optional[str],
 ):
     """One shard's MoE FFN. ``x`` (t, d); ``w_in`` (E_local, d, h),
-    ``w_out`` (E_local, h, d); ``gate_w`` (d, E_global) replicated."""
+    ``w_out`` (E_local, h, d); ``gate_w`` (d, E_global) replicated.
+
+    ``s_in``/``s_out`` (E_local, h) / (E_local, d) switch the expert
+    GEMMs to the quantized form: ``w_in``/``w_out`` are then int8/fp8
+    buffers whose upcast to the f32 accumulator dtype fuses into the
+    GEMM read (HBM and the all-to-alls never carry the dequantized
+    copy), with the per-(expert, out-channel) scales folded in as
+    epilogue multiplies."""
     t, d = x.shape
     num_experts = gate_w.shape[1]
     top_w, top_idx, pos_in_expert, kept, aux = _route(x @ gate_w, k, capacity)
@@ -148,8 +157,17 @@ def _moe_shard(
         # resident experts: (E, C, d) -> (E/N, N*C, d)
         expert_inputs = all_to_all(expert_inputs, axis, split_axis=0, concat_axis=1)
 
-    hidden = activation(jnp.einsum("ecd,edh->ech", expert_inputs, w_in))
-    expert_outputs = jnp.einsum("ech,ehd->ecd", hidden, w_out)
+    if s_in is None:
+        hidden = activation(jnp.einsum("ecd,edh->ech", expert_inputs, w_in))
+        expert_outputs = jnp.einsum("ech,ehd->ecd", hidden, w_out)
+    else:
+        comp = jnp.promote_types(x.dtype, jnp.float32)
+        pre = jnp.einsum(
+            "ecd,edh->ech", expert_inputs.astype(comp), w_in.astype(comp)
+        )
+        hidden = activation(pre * s_in[:, None, :].astype(comp)).astype(x.dtype)
+        pre = jnp.einsum("ech,ehd->ecd", hidden.astype(comp), w_out.astype(comp))
+        expert_outputs = (pre * s_out[:, None, :].astype(comp)).astype(x.dtype)
 
     if axis is not None:
         # inverse exchange: (E/N, N*C, d) -> (E, C, d), back token-resident
@@ -198,17 +216,55 @@ def moe_ffn(
         (y, aux): y shaped like ``x``; aux holds ``load_balance_loss``
         (add ``alpha * loss`` to the training objective) and
         ``fraction_dropped``.
+
+    ``w_in``/``w_out`` may also be :class:`~heat_tpu.core.quantize
+    .QuantizedTensor` pairs (``quantize_tensor(w, axis=(0, 2))`` /
+    ``quantize_params``): the expert GEMMs then read the int8/fp8
+    buffers directly with the per-(expert, channel) scales folded in,
+    dispatched per geometry as ``("bf16", "int8")`` autotune arms with
+    the usual explore-returns-reference guarantee.
     """
+    from ..core import quantize as _quantize
+
+    q_in = isinstance(w_in, _quantize.QuantizedTensor)
+    q_out = isinstance(w_out, _quantize.QuantizedTensor)
+    if q_in != q_out:
+        raise ValueError(
+            "moe_ffn: quantize both w_in and w_out or neither "
+            f"(got {type(w_in).__name__} / {type(w_out).__name__})"
+        )
+    if q_in:
+        return _moe_ffn_quantized(
+            x, gate_w, w_in, w_out, k=k, capacity_factor=capacity_factor,
+            activation=activation, mesh=mesh, axis=axis,
+        )
+    return _moe_run(
+        x, gate_w, w_in, w_out, None, None, k=k,
+        capacity_factor=capacity_factor, activation=activation, mesh=mesh,
+        axis=axis,
+    )
+
+
+def _moe_run(
+    x, gate_w, w_in, w_out, s_in, s_out, *, k, capacity_factor, activation,
+    mesh, axis,
+):
+    """The (possibly quantized) MoE step body behind :func:`moe_ffn`:
+    ``s_in``/``s_out`` are None for the master-dtype path, per-(expert,
+    channel) scales for the quantized one (they enter the shard program
+    as runtime operands — a re-quantized checkpoint never retraces)."""
     orig_shape = x.shape
     d = orig_shape[-1]
     x2 = x.reshape(-1, d)
     tokens = x2.shape[0]
     num_experts = gate_w.shape[1]
+    quantized = s_in is not None
 
     if mesh is None:
         cap = expert_capacity(tokens, num_experts, k, capacity_factor)
         y, aux = _moe_shard(
-            x2, gate_w, w_in, w_out, k=k, capacity=cap, activation=activation, axis=None
+            x2, gate_w, w_in, w_out, s_in, s_out, k=k, capacity=cap,
+            activation=activation, axis=None,
         )
         return y.reshape(orig_shape), aux
 
@@ -219,17 +275,78 @@ def moe_ffn(
         raise ValueError(f"num_experts {num_experts} not divisible by mesh axis {axis}={n}")
     cap = expert_capacity(tokens // n, num_experts, k, capacity_factor)
 
+    w_spec = NamedSharding(mesh, P(axis, None, None))
+    s_spec = NamedSharding(mesh, P(axis, None))
+    in_specs = [P(axis, None), P(), P(axis, None, None), P(axis, None, None)]
+    operands = [
+        jax.device_put(x2, NamedSharding(mesh, P(axis, None))),
+        gate_w,
+        jax.device_put(w_in, w_spec),
+        jax.device_put(w_out, w_spec),
+    ]
+    if quantized:
+        # scales shard with their experts, like the weights they scale
+        in_specs += [P(axis, None), P(axis, None)]
+        operands += [
+            jax.device_put(s_in, s_spec),
+            jax.device_put(s_out, s_spec),
+        ]
     shard_fn = shard_map_unchecked(
         partial(_moe_shard, k=k, capacity=cap, activation=activation, axis=axis),
         mesh,
-        in_specs=(P(axis, None), P(), P(axis, None, None), P(axis, None, None)),
+        in_specs=tuple(in_specs),
         out_specs=(P(axis, None), P()),
     )
-    spec = NamedSharding(mesh, P(axis, None))
-    y, aux = shard_fn(
-        jax.device_put(x2, spec),
-        gate_w,
-        jax.device_put(w_in, NamedSharding(mesh, P(axis, None, None))),
-        jax.device_put(w_out, NamedSharding(mesh, P(axis, None, None))),
-    )
+    y, aux = shard_fn(*operands)
     return y.reshape(orig_shape), aux
+
+
+def _moe_ffn_quantized(
+    x, gate_w, qw_in, qw_out, *, k, capacity_factor, activation, mesh, axis,
+):
+    """Arm-dispatched quantized MoE FFN: bf16 = dequantize both experts'
+    weights and run the master-dtype path (the reference arm — bitwise
+    the unquantized flow over the same dequantized values); int8 = the
+    low-precision buffers ride the expert GEMMs directly."""
+    from ..core import quantize as _quantize
+
+    for name, qt in (("w_in", qw_in), ("w_out", qw_out)):
+        if qt.axes != (0, 2):
+            raise ValueError(
+                f"moe_ffn: quantized {name} needs per-(expert, "
+                f"out-channel) scales — quantize with axis=(0, 2), got "
+                f"axes {qt.axes}"
+            )
+
+    def _bf16():
+        return _moe_run(
+            x, gate_w, _quantize.dequantize_tensor(qw_in),
+            _quantize.dequantize_tensor(qw_out), None, None, k=k,
+            capacity_factor=capacity_factor, activation=activation,
+            mesh=mesh, axis=axis,
+        )
+
+    def _int8():
+        return _moe_run(
+            x, gate_w, qw_in.q, qw_out.q, qw_in.scale, qw_out.scale, k=k,
+            capacity_factor=capacity_factor, activation=activation,
+            mesh=mesh, axis=axis,
+        )
+
+    if _quantize._is_traced(x):
+        # inside someone else's trace (grad/training): no timing, no
+        # table writes — the reference arm, unconditionally
+        return _bf16()
+    tokens = 1
+    for dim in x.shape[:-1]:
+        tokens *= dim
+    d = x.shape[-1]
+    n = 1 if mesh is None else mesh.shape[axis]
+    geometry = (
+        tokens, d, qw_in.shape[2], gate_w.shape[1], n, k, str(qw_in.q.dtype),
+    )
+    return _quantize.tuned_arm(
+        "moe_ffn", geometry, _bf16, _int8,
+        desc=f"moe_ffn t={tokens} d={d} h={qw_in.shape[2]} "
+             f"E={gate_w.shape[1]} S={n}",
+    )

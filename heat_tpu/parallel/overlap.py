@@ -291,6 +291,44 @@ class Epilogue:
     activation: Optional[Callable] = None
     dtype: Any = None
 
+    def __post_init__(self):
+        # fail at construction, not steps deep inside a ring program: a
+        # bad operand here would otherwise surface as a shard_map shape
+        # mismatch with no mention of the epilogue at all
+        for name in ("scale", "bias"):
+            value = getattr(self, name)
+            if value is None or isinstance(value, jax.core.Tracer):
+                continue
+            try:
+                arr = jnp.asarray(value)
+            except (TypeError, ValueError) as exc:
+                raise TypeError(
+                    f"Epilogue.{name} must be numeric/array-like "
+                    f"(got {type(value).__name__}): {exc}"
+                ) from None
+            if not jnp.issubdtype(arr.dtype, jnp.number):
+                raise TypeError(
+                    f"Epilogue.{name} must be numeric, got dtype {arr.dtype}"
+                )
+            if arr.ndim > 2:
+                raise ValueError(
+                    f"Epilogue.{name} must be scalar, 1-D, or 2-D — it "
+                    f"broadcasts against the 2-D matmul result; got "
+                    f"ndim={arr.ndim} (shape {tuple(arr.shape)})"
+                )
+        if self.activation is not None and not callable(self.activation):
+            raise TypeError(
+                "Epilogue.activation must be a traceable callable, got "
+                f"{type(self.activation).__name__}"
+            )
+        if self.dtype is not None:
+            try:
+                jnp.dtype(self.dtype)
+            except TypeError:
+                raise TypeError(
+                    f"Epilogue.dtype is not a dtype: {self.dtype!r}"
+                ) from None
+
     def lower(self):
         """→ ``(steps, extras)`` in the engine's internal encoding: each
         step is ``(fn, static_kwargs_items, arg_pattern)`` with ``-1`` in
@@ -308,6 +346,35 @@ class Epilogue:
         if self.dtype is not None:
             steps.append((_cast, (("dtype", jnp.dtype(self.dtype)),), (-1,)))
         return tuple(steps), tuple(extras)
+
+
+def _check_extras(extras, gshape, out_split) -> None:
+    """Validate epilogue extras against the GLOBAL result shape before a
+    ring program is built.  Each extra must broadcast against the 2-D
+    result; along the out-split axis the only legal extents are 1
+    (broadcast) or the full global extent (the kernel slices it per ring
+    block — see :func:`_extra_axes`).  A partial extent used to die deep
+    inside shard_map as an unrelated shape-mismatch error."""
+    for i, value in enumerate(extras):
+        es = tuple(value.shape)
+        if len(es) > 2:
+            raise ValueError(
+                f"epilogue extra {i} (shape {es}) cannot broadcast "
+                f"against the 2-D matmul result {tuple(gshape)}"
+            )
+        for off in range(1, len(es) + 1):
+            ext, full = es[-off], gshape[-off]
+            if ext in (1, full):
+                continue
+            res_ax = len(gshape) - off
+            sliced = out_split is not None and res_ax == out_split
+            raise ValueError(
+                f"epilogue extra {i} has shape {es}: axis {len(es) - off} "
+                f"has length {ext}, expected 1 or the full result extent "
+                f"{full} (result axis {res_ax} of {tuple(gshape)}"
+                + (", sliced per ring block along the out-split)"
+                   if sliced else ")")
+            )
 
 
 def _extra_axes(extra_shapes, gshape, out_split) -> tuple:
@@ -575,6 +642,8 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
         a.dtype, b.dtype
     )
     steps, extras = epilogue.lower() if epilogue is not None else ((), ())
+    if extras:
+        _check_extras(extras, (m, n), out_split)
     acc_isz = 4 if (jnp.issubdtype(comp, jnp.inexact) and comp.itemsize < 4) else comp.itemsize
     use, reason, bps = _decide(
         case, out_split, m, k, n, comm.size, comp.itemsize, acc_isz
@@ -736,6 +805,7 @@ def matmul(a, b, out_split="auto", *, epilogue: Optional[Epilogue] = None,
     m, k = a.shape
     n = b.shape[1]
     if steps:
+        _check_extras(extras, (m, n), out_split)
         out_aval = jax.eval_shape(
             lambda a_, b_, *ex: _apply_steps(
                 jnp.matmul(a_.astype(comp), b_.astype(comp)), steps, ex

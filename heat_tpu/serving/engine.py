@@ -202,6 +202,7 @@ class ServingEngine:
         max_batch: int = 64,
         max_delay_s: Optional[float] = None,
         warm: bool = False,
+        quantize: bool = False,
     ) -> Endpoint:
         """Register an endpoint: exactly one of ``model`` (an object with
         ``.predict``) or ``predict`` (a ``DNDarray -> DNDarray`` callable).
@@ -209,11 +210,25 @@ class ServingEngine:
         ``min_bucket`` defaults to ``max(8, mesh size)`` so split-0
         batches always give every device at least one row; ``max_batch``
         is rounded up to the bucket ladder's top rung.  ``warm=True``
-        compiles every bucket before the first request lands."""
+        compiles every bucket before the first request lands.
+
+        ``quantize=True`` calls ``model.quantize_()`` before serving —
+        the model drops its full-precision resident state for int8
+        (e.g. ``KNeighborsClassifier`` quantizes its corpus and serves
+        through the quantized ring cdist); requires ``model`` with a
+        ``quantize_`` method."""
         if self._closed:
             raise RuntimeError("serving engine is closed")
         if (model is None) == (predict is None):
             raise ValueError("pass exactly one of `model` or `predict`")
+        if quantize:
+            hook = getattr(model, "quantize_", None)
+            if hook is None:
+                raise ValueError(
+                    "quantize=True needs a `model` exposing quantize_() "
+                    f"(got {type(model).__name__})"
+                )
+            hook()
         if predict is None:
             predict = model.predict
         if name in self._endpoints:
@@ -241,6 +256,7 @@ class ServingEngine:
             feature_dim=feature_dim,
             buckets=list(buckets),
             split=split,
+            quantized=bool(quantize),
         )
         if warm:
             self.warmup(name)

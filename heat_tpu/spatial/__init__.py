@@ -1,6 +1,6 @@
 """Spatial / pairwise-distance functions (reference: heat/spatial/)."""
 
 from . import distance
-from .distance import cdist, rbf, manhattan
+from .distance import cdist, cdist_quantized, rbf, manhattan
 
-__all__ = ["distance", "cdist", "rbf", "manhattan"]
+__all__ = ["distance", "cdist", "cdist_quantized", "rbf", "manhattan"]

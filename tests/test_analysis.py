@@ -191,6 +191,26 @@ class TestLintRules(TestCase):
         )
         self.assertNotIn("HT005", codes)
 
+    def test_ht005_fires_on_use_after_quantize_donate(self):
+        codes = _codes(
+            """
+            def f(w):
+                qw = quantize.quantize_weights(w, "int8", donate=True)
+                return w.numpy(), qw
+            """
+        )
+        self.assertIn("HT005", codes)
+
+    def test_ht005_quiet_on_quantize_without_donate(self):
+        codes = _codes(
+            """
+            def f(w):
+                qw = quantize.quantize_weights(w, "int8")
+                return w.numpy(), qw
+            """
+        )
+        self.assertNotIn("HT005", codes)
+
     def test_inline_suppression_silences_with_reason(self):
         src = (
             "import os\n"
@@ -437,6 +457,23 @@ class TestSanitizer(TestCase):
             evts = telemetry.events("analysis_finding")
             self.assertTrue(
                 any(e.get("rule") == "use_after_donate" for e in evts)
+            )
+
+    def test_quantize_donate_poisons_master(self):
+        from heat_tpu.core import quantize
+
+        with _Scope(sanitize_on=True, level="events"):
+            w = ht.array(
+                np.random.default_rng(0).standard_normal((16, 8)).astype(
+                    np.float32
+                ),
+                split=0,
+            )
+            quantize.quantize_weights(w, "int8", axis=0, donate=True)
+            with self.assertRaises(UseAfterDonateError) as cm:
+                (w + 1.0).numpy()
+            self.assertIn(
+                "quantize.quantize_weights(donate=True)", str(cm.exception)
             )
 
     def test_fusion_funnel_checks_leaves(self):

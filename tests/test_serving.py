@@ -488,5 +488,93 @@ class TestTelemetrySurface(TestCase):
             self.assertIn("serving_drain", kinds)
 
 
+class TestQuantizedKnnServing(TestCase):
+    """ISSUE 15 workload: a k-NN endpoint registered with
+    ``quantize=True`` serves batched queries against the int8 corpus —
+    correct labels, released f32 master, and the same no-retrace law as
+    every other endpoint (steady bucketed traffic adds zero fusion
+    misses, zero ring builds, zero step compiles)."""
+
+    def _fitted_knn(self, n=64, f=16):
+        X = _RNG.normal(size=(n, f)).astype(np.float32)
+        labels = (X[:, 0] > 0).astype(np.int32)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=3)
+        knn.fit(ht.array(X, split=0), ht.array(labels, split=0))
+        return knn
+
+    def test_register_quantize_requires_hook(self):
+        eng = _engine()
+        try:
+            with self.assertRaisesRegex(ValueError, "quantize_"):
+                eng.register(
+                    "q", predict=lambda x: x, feature_dim=4, quantize=True
+                )
+        finally:
+            eng.close()
+
+    def test_quantized_endpoint_serves_and_never_retraces(self):
+        knn = self._fitted_knn()
+        eng = _engine()
+        try:
+            ep = eng.register(
+                "knn_q", knn, feature_dim=16, min_bucket=8, max_batch=32,
+                max_delay_s=0.002, warm=True, quantize=True,
+            )
+            self.assertIsNone(knn.x)  # master released at registration
+            self.assertIsNotNone(knn._qx)
+
+            sizes = [1, 3, 8, 2, 16, 5, 7, 4, 1, 12, 32, 6] * 2
+            payloads = [
+                _RNG.normal(size=(s, 16)).astype(np.float32) for s in sizes
+            ]
+            for p in payloads[: len(ep.buckets)]:
+                eng.predict("knn_q", p)
+
+            fusion_before = telemetry.snapshot_group("fusion").get("misses", 0)
+            overlap_before = telemetry.snapshot_group("overlap").get(
+                "ring_builds", 0
+            )
+            steps_before = eng.stats()["step_compiles"]
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                futures = list(
+                    pool.map(lambda p: eng.submit("knn_q", p), payloads)
+                )
+                results = [f.result(60) for f in futures]
+            for p, r in zip(payloads, results):
+                self.assertEqual(np.asarray(r).shape[0], p.shape[0])
+
+            self.assertEqual(
+                telemetry.snapshot_group("fusion").get("misses", 0),
+                fusion_before,
+                "steady traffic on the quantized corpus must not miss "
+                "the fusion compile cache",
+            )
+            self.assertEqual(
+                telemetry.snapshot_group("overlap").get("ring_builds", 0),
+                overlap_before,
+                "the quantized ring cdist must reuse its shard program",
+            )
+            self.assertEqual(eng.stats()["step_compiles"], steps_before)
+        finally:
+            eng.close()
+
+    def test_quantized_endpoint_labels_agree_with_f32(self):
+        knn = self._fitted_knn(n=48, f=8)
+        q = _RNG.normal(size=(8, 8)).astype(np.float32)
+        ref = np.asarray(knn.predict(ht.array(q, split=0)).numpy())
+        eng = _engine()
+        try:
+            eng.register(
+                "knn_q", knn, feature_dim=8, max_batch=16, quantize=True
+            )
+            got = np.asarray(eng.predict("knn_q", q)).ravel()
+            # int8 corpus can flip exact distance ties; near-total
+            # agreement is the contract (test_quantize pins the bound)
+            self.assertGreaterEqual(float((ref.ravel() == got).mean()), 0.9)
+        finally:
+            eng.close()
+
+
 if __name__ == "__main__":
     unittest.main()
