@@ -199,5 +199,14 @@ SERVING_REQS = 256 if ON_TPU else 96
 # region) stays in seconds while the weight is big enough that the
 # residency columns mean something
 QLINEAR_M, QLINEAR_K, QLINEAR_N = (8_192, 8_192, 8_192) if ON_TPU else (256, 512, 256)
+# quantized-collective rows (round 17): the absmax wire formats through
+# the real movement engines.  Sized so every dispatch clears the default
+# 64 KiB HEAT_TPU_WIRE_MIN_BYTES threshold on the CPU mesh (resplit:
+# 512x256 f32 = 512 KiB; ring ag: 64x256 f32 blocks x 7 hops = 448 KiB)
+# and the modeled on-wire delta is worth recording
+WIRE_RESPLIT_SHAPE = (16_384, 4_096) if ON_TPU else (512, 256)
+WIRE_MM_M, WIRE_MM_K, WIRE_MM_N = (
+    (4_096, 8_192, 4_096) if ON_TPU else (256, 512, 256)
+)
 QKNN_N, QKNN_F = (65_536, 64) if ON_TPU else (2_048, 32)
 QKNN_REQS = 128 if ON_TPU else 48

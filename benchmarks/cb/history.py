@@ -78,6 +78,14 @@ TOLERANCE = {
     # single-run batched wall over a thread pool, same contract as
     # serving_batch: Python thread scheduling rides the number
     "serving_knn": 0.5,
+    # round-17 quantized-collective rows (wire.py's own notes): the wall
+    # rides the FORCED int8 arm, which on the CPU CI mesh is extra work
+    # (no ICI to relieve — the quant/dequant pass is pure overhead whose
+    # cost depends on host scheduling), so the headline these rows vouch
+    # for is the exact wire-ledger byte columns and the measured error
+    # bound, both checked by the ci.sh stage-20 gate, not the wall
+    "resplit_wire_int8": 0.5,
+    "matmul_ring_wire": 0.5,
 }
 
 _ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")

@@ -19,6 +19,7 @@ import nn
 import quantize
 import regression
 import serving
+import wire
 
 from heat_tpu.core import telemetry as _telemetry
 from heat_tpu.utils import monitor as _monitor
@@ -92,7 +93,7 @@ if __name__ == "__main__":
         default=None,
         help="comma-separated subset: "
              "linalg,cluster,manipulations,nn,regression,fusion,kernels,"
-             "serving,quantize",
+             "serving,quantize,wire",
     )
     ap.add_argument(
         "--check-regression",
@@ -114,6 +115,7 @@ if __name__ == "__main__":
         "quantize": quantize.run,
         "regression": regression.run,
         "serving": serving.run,
+        "wire": wire.run,
     }
     selected = (
         [s.strip() for s in args.only.split(",") if s.strip()]

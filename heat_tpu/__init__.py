@@ -40,6 +40,7 @@ from .core import (
     trigonometrics,
     types,
     version,
+    wire,
 )
 from .core.version import __version__
 from . import parallel

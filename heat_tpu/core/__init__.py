@@ -81,3 +81,4 @@ from .tiling import *
 from . import linalg
 from .linalg import *
 from . import quantize
+from . import wire

@@ -80,7 +80,9 @@ __all__ = [
     "set_enabled",
     "stats",
     "table",
+    "WIRE_ARMS",
     "winner",
+    "wire_key",
 ]
 
 ARMS = ("ring", "gspmd")
@@ -95,9 +97,20 @@ KERNEL_ARMS = ("classic", "kernel")
 # ring epilogue.  The reference arm name stays "bf16" for fp8 entries
 # too: the arm names the REFERENCE precision class, not the storage.
 QUANT_ARMS = ("bf16", "int8")
+# round 17: quantized collectives (core/wire.py) — the WIRE format of the
+# data-movement engines.  "wire_f32" is the reference arm (today's
+# full-precision collective, byte-for-byte); "wire_int8"/"wire_fp8" ship
+# absmax-scaled low-precision tiles over the all_to_all/ppermute and
+# dequantize on landing.  Distinct from QUANT_ARMS: those pick what the
+# GEMM *computes on*, these pick what the COLLECTIVE *ships* — a site can
+# hold both kinds of entries at once.
+WIRE_ARMS = ("wire_f32", "wire_int8", "wire_fp8")
 # every arm name any entry may carry; load() refuses winners outside it
 # so a corrupt cache cannot inject an undispatched arm
-_KNOWN_ARMS = frozenset(ARMS) | frozenset(KERNEL_ARMS) | frozenset(QUANT_ARMS)
+_KNOWN_ARMS = (
+    frozenset(ARMS) | frozenset(KERNEL_ARMS) | frozenset(QUANT_ARMS)
+    | frozenset(WIRE_ARMS)
+)
 CACHE_VERSION = 1
 
 # samples kept per arm (min_s over a bounded window; enough for the
@@ -343,6 +356,17 @@ def quant_key(site: str, *geometry) -> Tuple[str, str]:
     vs "int8" (the low-precision buffer rides the GEMM, per-channel
     scales fold into the ring epilogue as runtime extras)."""
     fp = telemetry.fingerprint(("quant", site) + tuple(geometry))
+    return fp, device_kind()
+
+
+def wire_key(site: str, *geometry) -> Tuple[str, str]:
+    """Tuning-table key for one quantized-collective dispatch site
+    (``resplit`` / ``rechunk`` / ``ring_ag`` / ``ring_col`` / ``cdist``
+    — see core/wire.py) at one geometry.  The entry's arms are
+    :data:`WIRE_ARMS`: "wire_f32" (the full-precision collective explore
+    returns bitwise) vs "wire_int8"/"wire_fp8" (absmax-scaled tiles on
+    the wire, f32 scales beside them, dequantized on landing)."""
+    fp = telemetry.fingerprint(("wire", site) + tuple(geometry))
     return fp, device_kind()
 
 

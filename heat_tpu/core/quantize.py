@@ -56,7 +56,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from . import autotune, memtrack, telemetry, types
+from . import autotune, memtrack, telemetry, types, wire as _wire
 from .dndarray import DNDarray, _ensure_split
 from ..analysis import sanitize
 
@@ -73,23 +73,12 @@ __all__ = [
     "tuned_arm",
 ]
 
-# absmax-per-channel maps onto the quantized grid's largest magnitude
-_QMAX = {"int8": 127.0, "fp8": 448.0}
-
-
-def _qdtype(dtype: str):
-    if dtype == "int8":
-        return jnp.dtype(jnp.int8)
-    if dtype == "fp8":
-        f8 = getattr(jnp, "float8_e4m3fn", None)
-        if f8 is None:
-            raise ValueError(
-                "fp8 quantization needs a jax with float8_e4m3fn support"
-            )
-        return jnp.dtype(f8)
-    raise ValueError(
-        f"quantize dtype must be 'int8' or 'fp8', got {dtype!r}"
-    )
+# absmax-per-channel maps onto the quantized grid's largest magnitude.
+# The grid math lives in core/wire.py now (round 17 made it the shared
+# tile-quant helper of the quantized-collective wire formats); these
+# aliases keep this module's surface stable.
+_QMAX = _wire.QMAX
+_qdtype = _wire.qdtype
 
 
 _STATS = telemetry.register_group(
@@ -133,19 +122,12 @@ def _quantize_body(w, qdt, axes):
     axes — ``(1,)`` for a 2-D weight's columns, ``(0, 2)`` for
     per-(expert, channel) scales on a 3-D MoE weight.  Scales stay f32;
     all-zero channels get scale 1 so the dequant is exact zeros, never
-    0/0."""
-    qmax = _QMAX["int8" if qdt == jnp.dtype(jnp.int8) else "fp8"]
-    wf = w.astype(jnp.float32)
-    reduce_axes = tuple(d for d in range(w.ndim) if d not in axes)
-    absmax = jnp.max(jnp.abs(wf), axis=reduce_axes)
-    scale = jnp.where(absmax > 0, absmax / qmax, 1.0).astype(jnp.float32)
-    sb = jnp.expand_dims(scale, reduce_axes)
-    grid = wf / sb
-    if qdt == jnp.dtype(jnp.int8):
-        q = jnp.clip(jnp.round(grid), -qmax, qmax).astype(qdt)
-    else:
-        q = jnp.clip(grid, -qmax, qmax).astype(qdt)
-    return q, scale
+    0/0.  One grid, one implementation: this is the same
+    ``wire.absmax_encode`` the quantized collectives ship tiles through,
+    so a weight quantized here and a tile quantized on the wire agree
+    bit-for-bit on the same values."""
+    mode = "int8" if qdt == jnp.dtype(jnp.int8) else "fp8"
+    return _wire.absmax_encode(w, mode, axes)
 
 
 @jax.tree_util.register_pytree_node_class

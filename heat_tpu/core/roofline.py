@@ -168,6 +168,23 @@ def attribute(entry: dict, peaks: Optional[dict] = None) -> Optional[dict]:
     # operands (staging copies, retained intermediates, mirror buffers).
     peak_bytes = entry.get("peak_bytes")
     amp = round(peak_bytes / hbm, 3) if peak_bytes and hbm else None
+    # wire axis (round 17, core/wire.py): programs that ship a quantized
+    # collective carry the byte model of what the f32 wire WOULD have
+    # moved (logical) vs what the quantized format moved (wire).  The
+    # flip marker re-runs the structural verdict with the wire volume
+    # folded into the movement bound, compressed vs uncompressed — True
+    # means the compression is what moved this row off (or onto) the
+    # memory-bound tail, so the row must not be read as a compute win.
+    wire = entry.get("wire")
+    w_logical = float(entry.get("logical_bytes") or 0.0)
+    w_wire = float(entry.get("wire_bytes") or 0.0)
+    wire_ratio = round(w_logical / w_wire, 2) if wire and w_wire else None
+    wire_flip = None
+    if wire and peaks.get("known") and verdict != "unknown-peak" and peak_bw:
+        t_compute_ = flops / peak_flops if peak_flops else 0.0
+        v_c = "memory-bound" if (hbm + w_wire) / peak_bw >= t_compute_ else "compute-bound"
+        v_u = "memory-bound" if (hbm + w_logical) / peak_bw >= t_compute_ else "compute-bound"
+        wire_flip = v_c != v_u
     return {
         "fingerprint": entry["fingerprint"],
         "kind": entry.get("kind"),
@@ -186,6 +203,11 @@ def attribute(entry: dict, peaks: Optional[dict] = None) -> Optional[dict]:
         "mem_source": entry.get("mem_source"),
         "verdict": verdict,
         "mesh": entry.get("mesh"),
+        "wire": wire,
+        "wire_logical_bytes": w_logical if wire else None,
+        "wire_bytes": w_wire if wire else None,
+        "wire_ratio": wire_ratio,
+        "wire_verdict_flip": wire_flip,
     }
 
 
@@ -232,18 +254,27 @@ def render(doc: Optional[dict] = None, top: Optional[int] = None) -> str:
     lines.append(
         f"{'fingerprint':<14}{'kind':<20}{'calls':>6}{'total_s':>10}"
         f"{'p50_s':>10}{'GFLOP/s':>10}{'GB/s':>9}{'%comp':>7}{'%hbm':>7}"
-        f"{'peakMB':>8}{'amp':>6}  verdict"
+        f"{'peakMB':>8}{'amp':>6}{'lgclMB':>9}{'wireMB':>8}{'wire_x':>7}"
+        "  verdict"
     )
     for r in doc["rows"]:
         pc = f"{100 * r['frac_compute_roofline']:.1f}" if r["frac_compute_roofline"] is not None else "-"
         ph = f"{100 * r['frac_hbm_roofline']:.1f}" if r["frac_hbm_roofline"] is not None else "-"
         pk = f"{r['peak_bytes'] / 1e6:.1f}" if r.get("peak_bytes") else "-"
         am = f"{r['mem_amplification']:.2f}" if r.get("mem_amplification") else "-"
+        if r.get("wire"):
+            lg = f"{r['wire_logical_bytes'] / 1e6:.2f}"
+            wi = f"{r['wire_bytes'] / 1e6:.2f}"
+            wx = f"{r['wire_ratio']:.1f}" if r.get("wire_ratio") else "-"
+        else:
+            lg = wi = wx = "-"
+        flip = " [wire-flip]" if r.get("wire_verdict_flip") else ""
         lines.append(
             f"{r['fingerprint']:<14}{(r['kind'] or ''):<20}{r['calls']:>6}"
             f"{r['total_s']:>10.4f}{r['p50_s']:>10.6f}"
             f"{r['achieved_gflops']:>10.2f}{r['achieved_gbps']:>9.2f}"
-            f"{pc:>7}{ph:>7}{pk:>8}{am:>6}  {r['verdict']}"
+            f"{pc:>7}{ph:>7}{pk:>8}{am:>6}{lg:>9}{wi:>8}{wx:>7}"
+            f"  {r['verdict']}{flip}"
         )
     if doc["memory_bound_tail"]:
         lines.append(

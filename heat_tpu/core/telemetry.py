@@ -380,6 +380,51 @@ def _mem_prom_lines(lines: List[str]) -> None:
             lines.append(f'{name}{{dtype="{_label_escape(dt)}"}} {val}')
 
 
+def _wire_prom_lines(lines: List[str]) -> None:
+    """Labeled per-program wire gauges for ledger entries that ship a
+    quantized collective: the f32 bytes the program WOULD have moved
+    (``heat_tpu_wire_program_logical_bytes``), what its wire format
+    actually moved (``heat_tpu_wire_program_bytes``), and the ratio —
+    keyed by ``{fingerprint=...,arm=...}``.  The aggregate ``wire`` group
+    counters (``heat_tpu_wire_bytes_logical`` etc.) already ride the
+    generic group exposition; these break the same story down per
+    program so a dashboard can name the compressed collectives."""
+    rows = [
+        e for e in programs()
+        if e.get("wire") and isinstance(e.get("wire_bytes"), (int, float))
+    ]
+    if not rows:
+        return
+    for field, metric, help_ in (
+        ("logical_bytes", "heat_tpu_wire_program_logical_bytes",
+         "f32 bytes the program's collective would move uncompressed"),
+        ("wire_bytes", "heat_tpu_wire_program_bytes",
+         "bytes the program's quantized wire format moves"),
+    ):
+        lines.append(f"# HELP {metric} heat_tpu telemetry gauge {help_}")
+        lines.append(f"# TYPE {metric} gauge")
+        for e in rows:
+            labels = (
+                f'fingerprint="{_label_escape(e["fingerprint"])}"'
+                f',arm="{_label_escape(e["wire"])}"'
+            )
+            lines.append(f"{metric}{{{labels}}} {float(e.get(field) or 0.0)}")
+    metric = "heat_tpu_wire_program_ratio"
+    lines.append(f"# HELP {metric} heat_tpu telemetry gauge logical/wire "
+                 f"byte compression ratio")
+    lines.append(f"# TYPE {metric} gauge")
+    for e in rows:
+        wb = float(e.get("wire_bytes") or 0.0)
+        lb = float(e.get("logical_bytes") or 0.0)
+        if wb <= 0.0:
+            continue
+        labels = (
+            f'fingerprint="{_label_escape(e["fingerprint"])}"'
+            f',arm="{_label_escape(e["wire"])}"'
+        )
+        lines.append(f"{metric}{{{labels}}} {round(lb / wb, 4)}")
+
+
 def export_prometheus() -> str:
     """Text exposition format (``# HELP`` + ``# TYPE gauge`` + one value
     line per numeric leaf): every registered group flattened as
@@ -397,6 +442,7 @@ def export_prometheus() -> str:
         )
     _program_prom_lines(lines)
     _mem_prom_lines(lines)
+    _wire_prom_lines(lines)
     return "\n".join(lines) + "\n"
 
 

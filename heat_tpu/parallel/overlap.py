@@ -65,6 +65,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import autotune, memtrack, telemetry
+from ..core import wire as _wire
 from ..analysis import program_audit, sanitize
 from .collectives import (
     all_gather,
@@ -130,8 +131,14 @@ def _ring_min_bytes() -> int:
 
 def _dispatch_salt() -> tuple:
     # participates in the fusion compile-cache key: flipping the mode or
-    # threshold must build a distinct entry, not reuse the other mode's
-    return ("overlap", _mode(), _ring_min_bytes())
+    # threshold must build a distinct entry, not reuse the other mode's.
+    # The wire knobs join for the same reason — a forced HEAT_TPU_WIRE
+    # flip changes the chain's compiled collectives without any autotune
+    # generation bump (winner flips ride autotune.salt instead).
+    return (
+        "overlap", _mode(), _ring_min_bytes(), _wire.mode(),
+        _wire.min_bytes(),
+    )
 
 
 def _ceil_mult(n: int, s: int) -> int:
@@ -256,9 +263,19 @@ def ring_sweep(axis: str, n_steps: int, moving, state, step: Callable):
     with the local work on block t.  Unrolling (python range, not
     fori_loop) is what makes the overlap possible — a loop iteration is a
     scheduling barrier, an unrolled chain is not.  The final useless shift
-    is elided."""
+    is elided.
+
+    ``moving`` may be any pytree — every leaf hops together, which is how
+    a quantized block and its scale table ride the same ring position
+    (the wire arms of :func:`_build_ring`)."""
     for t in range(n_steps):
-        nxt = ring_shift(moving, axis, shift=1) if t + 1 < n_steps else None
+        nxt = (
+            jax.tree_util.tree_map(
+                lambda v: ring_shift(v, axis, shift=1), moving
+            )
+            if t + 1 < n_steps
+            else None
+        )
         state = step(t, moving, state)
         moving = nxt
     return state
@@ -411,6 +428,9 @@ class _Spec(NamedTuple):
     extra_axes: tuple
     prec: Any
     fold: bool       # return (block, allfinite) for the folded guard
+    wire: str = ""   # on-wire format of the moving block ("" | int8 | fp8):
+    #                  the ring ships absmax-quantized hops with one f32
+    #                  scale per contraction slice (core/wire.py)
 
 
 def _build_ring(mesh, spec: _Spec):
@@ -474,7 +494,10 @@ def _build_ring(mesh, spec: _Spec):
         return blk, lax.pmin(ok.astype(jnp.int32), axis)
 
     if case == "ag":
-        # stationary A row-block needs every k-block of B: rotate them
+        # stationary A row-block needs every k-block of B: rotate them.
+        # wire arm: the moving (kb, n) block hops as (int8/fp8 grid,
+        # per-k-row f32 scales) — the masked k-pad rows are exact zeros
+        # with scale 1, so padding survives the lossy wire bitwise
         def kernel(a_loc, b_loc, *extras):
             me = lax.axis_index(axis)
             av = a_loc.astype(comp)                      # (mb, k)
@@ -482,20 +505,27 @@ def _build_ring(mesh, spec: _Spec):
             if kp != k:
                 bv = _mask_k(bv, me, 0)
                 av = jnp.pad(av, ((0, 0), (0, kp - k)))
+            moving0 = _wire.absmax_encode(bv, spec.wire, (0,)) if spec.wire else bv
 
             def step(t, moving, acc):
                 src = (me - t) % S
                 a_blk = lax.dynamic_slice_in_dim(av, src * kb, kb, axis=1)
-                return acc + _dot(a_blk, moving)
+                if spec.wire:
+                    blk = _wire.absmax_decode(moving[0], moving[1], (0,), comp)
+                else:
+                    blk = moving
+                return acc + _dot(a_blk, blk)
 
-            acc = ring_sweep(axis, S, bv, jnp.zeros((mb, n), acc_dt), step)
+            acc = ring_sweep(axis, S, moving0, jnp.zeros((mb, n), acc_dt), step)
             return _finish(acc, extras, me)
 
         in_op = (P(axis, None), P(axis, None))
         out_spec = P(axis, None)
 
     elif case == "col":
-        # stationary B col-block needs every k-block of A: rotate them
+        # stationary B col-block needs every k-block of A: rotate them.
+        # wire arm: the moving (m, kb) block hops quantized with one f32
+        # scale per k-column (the contraction slice, mirroring ag)
         def kernel(a_loc, b_loc, *extras):
             me = lax.axis_index(axis)
             av = a_loc.astype(comp)                      # (m, kb)
@@ -503,13 +533,18 @@ def _build_ring(mesh, spec: _Spec):
             if kp != k:
                 av = _mask_k(av, me, 1)
                 bv = jnp.pad(bv, ((0, kp - k), (0, 0)))
+            moving0 = _wire.absmax_encode(av, spec.wire, (1,)) if spec.wire else av
 
             def step(t, moving, acc):
                 src = (me - t) % S
                 b_blk = lax.dynamic_slice_in_dim(bv, src * kb, kb, axis=0)
-                return acc + _dot(moving, b_blk)
+                if spec.wire:
+                    blk = _wire.absmax_decode(moving[0], moving[1], (1,), comp)
+                else:
+                    blk = moving
+                return acc + _dot(blk, b_blk)
 
-            acc = ring_sweep(axis, S, av, jnp.zeros((m, nb), acc_dt), step)
+            acc = ring_sweep(axis, S, moving0, jnp.zeros((m, nb), acc_dt), step)
             return _finish(acc, extras, me)
 
         in_op = (P(None, axis), P(None, axis))
@@ -543,7 +578,10 @@ def _build_ring(mesh, spec: _Spec):
             # step while the next local partial dot — independent of the
             # in-flight transfer — computes.  After S-1 hops every
             # accumulator reaches its destination with all S contributions:
-            # a reduce-scatter unrolled into the ring.
+            # a reduce-scatter unrolled into the ring.  The wire plane
+            # never quantizes this case: re-snapping the PARTIAL SUM to a
+            # fresh absmax grid every hop compounds the rounding error S
+            # times over (dispatchers decline it statically).
             acc = partial_((me - 1) % S)
             for t in range(1, S):
                 sent = ring_shift(acc, axis, shift=1)
@@ -585,13 +623,13 @@ def _pad_physical(v, lshape, split, S):
 
 
 def _spec_for(comm, case, out_split, m, k, n, comp, steps, extra_axes,
-              precision, fold):
+              precision, fold, wire=""):
     comp = jnp.dtype(comp)
     half = jnp.issubdtype(comp, jnp.inexact) and comp.itemsize < 4
     acc = jnp.dtype(jnp.float32) if half else comp
     return _Spec(
         case, out_split, comm.split_axis, comm.size, m, k, n,
-        str(comp), str(acc), steps, extra_axes, precision, fold,
+        str(comp), str(acc), steps, extra_axes, precision, fold, wire,
     )
 
 
@@ -624,13 +662,21 @@ def _gspmd_reference(mesh, spec: _Spec):
 
 def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
                out_split=None, *, comp_dtype=None, epilogue: Optional[Epilogue] = None,
-               precision=None):
+               precision=None, exact: bool = False):
     """Raw-array eager entry (the DNDarray-free engine core, for callers
     like ``linalg.qr`` and ``cluster.kmeans`` that hold jax arrays):
     dispatches one 2-D sharded GEMM, returning the physical result array —
     or ``None`` when the dispatcher picks GSPMD and the caller should run
     its own einsum.  ``a``/``b`` may be logical (zero-padded here) or
-    already physical."""
+    already physical.
+
+    Wire plane (round 17): the ``ag``/``col`` rings may ship their moving
+    block absmax-quantized (int8/fp8 grid + f32 scales per contraction
+    slice) — a second tuning axis over :data:`autotune.WIRE_ARMS`,
+    consulted only once the ring-vs-GSPMD entry has stopped exploring.
+    ``exact=True`` pins the f32 wire (linalg callers whose residuals are
+    measured in ulps); the ``rs`` case always declines (the traveling
+    partial sum cannot be re-quantized per hop)."""
     sanitize.check_use(a, "overlap.matmul_raw")
     sanitize.check_use(b, "overlap.matmul_raw")
     m, k = lshape_a
@@ -688,10 +734,33 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     if not use:
         _record("gspmd", steps=0, bps=bps, out_split=out_split, reason=reason)
         return None
+
+    # wire-arm consult (core/wire.py): a SECOND tuning axis, deliberately
+    # sequenced after the ring-vs-GSPMD axis — while the ring entry still
+    # explores, the wire stays f32 so each explore measures one variable.
+    # kb-slice scale counts make the byte model exact: per hop the moving
+    # block ships 1-byte elements plus kb f32 scales, (S-1) hops total.
+    S_ = comm.size
+    kb_ = _ceil_mult(k, S_) // S_
+    wire_arm, wire_d, wm = "wire_f32", None, ""
+    if case == "rs":
+        _wire.decline("ring_rs")
+    elif not (tune is not None and tune.explore) and _wire.eligible(
+        comp, bps * (S_ - 1), exact=exact
+    ):
+        wire_arm, wire_d = _wire.choose(
+            "ring_" + case, (m, k, n, S_, str(comp)),
+            desc=f"ring_{case} {m}x{k}x{n} {comp} S={S_}",
+        )
+        if wire_d is None or not wire_d.explore:
+            wm = "" if wire_arm == "wire_f32" else wire_arm[len("wire_"):]
+    wire_elems = (kb_ * n if case == "ag" else m * kb_) * (S_ - 1)
+    wire_total = lambda w: _wire.payload_nbytes(wire_elems, kb_ * (S_ - 1), w)
+
     extra_axes = _extra_axes([tuple(v.shape) for v in extras], (m, n), out_split)
     spec = _spec_for(
         comm, case, out_split, m, k, n, comp, steps, extra_axes, precision,
-        fold=False,
+        fold=False, wire=wm,
     )
     a = _pad_physical(a, lshape_a, 0 if case == "ag" else 1, comm.size)
     b = _pad_physical(b, lshape_b, 1 if case == "col" else 0, comm.size)
@@ -702,10 +771,14 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     seen_key = (id(comm.mesh), spec)
     hit = seen_key in _SEEN
     _SEEN.add(seen_key)
+    # a wire-armed dispatch gets its own ledger row ("ring_wire" prefix):
+    # the roofline must see the compressed hop volume against the same
+    # logical bytes instead of averaging arms into one row
+    fp_parts = ("ring", case, out_split, m, k, n, str(comp), len(steps))
+    if wm:
+        fp_parts = ("ring_wire",) + fp_parts[1:] + (wm,)
     ring_fp = (
-        telemetry.fingerprint(
-            ("ring", case, out_split, m, k, n, str(comp), len(steps)),
-        )
+        telemetry.fingerprint(fp_parts)
         if telemetry.ledger_enabled()
         else None
     )
@@ -738,23 +811,56 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
                 # (inf keeps the explore phase bounded)
                 gspmd_s = float("inf")
             autotune.observe(tune.key, "gspmd", gspmd_s)
+        elif wire_d is not None and wire_d.explore:
+            # wire explore round: the f32 ring (this `fn` — wm is "")
+            # and both quantized rings run under measurement; the f32
+            # result is returned, so numerics never depend on tuning
+            # state.  First-sample compile walls are absorbed by the
+            # per-arm min over explore_k samples.
+            if hit:
+                telemetry.program_hit(ring_fp)
+
+            def run_for(wmx):
+                if not wmx:
+                    return fn(a, b, *extras)
+                fnx = jit_shard_map_cached(
+                    _build_ring, comm.mesh, spec._replace(wire=wmx)
+                )
+                return fnx(a, b, *extras)
+
+            out = _wire.explore(wire_d, run_for)
         elif hit:
             # steady state: count the ledger hit and (sampled) wall-clock
             # the executable; the first call below traces+compiles, so
             # its wall would pollute min/p50 and is left unmeasured.
             # A tuned winner keeps being watched through the sampled
             # observer — the degradation guard that re-explores a ring
-            # gone >2x slower than its recorded best.
+            # gone >2x slower than its recorded best.  A wire-armed
+            # dispatch feeds BOTH watches: the ring entry and the wire
+            # entry each see the measured wall.
             telemetry.program_hit(ring_fp)
+            obs_list = []
+            if tune is not None:
+                obs_list.append(
+                    functools.partial(autotune.observe, tune.key, "ring")
+                )
+            if wm and wire_d is not None:
+                obs_list.append(
+                    functools.partial(autotune.observe, wire_d.key, wire_arm)
+                )
             observer = (
-                functools.partial(autotune.observe, tune.key, "ring")
-                if tune is not None else None
+                (lambda dur_s: [o(dur_s) for o in obs_list])
+                if obs_list else None
             )
             out = telemetry.timed_call(
                 ring_fp, fn, a, b, *extras, observer=observer
             )
         else:
             out = fn(a, b, *extras)
+    if wm:
+        _wire.account(
+            "ring_" + case, wire_arm, bps * (S_ - 1), wire_total(wm)
+        )
     memtrack.register_buffer(out, tag="output", split=out_split)
     sanitize.collective_event(
         "ring_" + case, axis=str(comm.split_axis), site="overlap.matmul_raw"
@@ -767,6 +873,13 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
     # GEMM FLOPs plus the mandatory HBM traffic (operands + result once —
     # the per-step wire bytes are ICI, not HBM)
     if not hit and ring_fp is not None:
+        extra_kw = {}
+        if wm:
+            extra_kw = dict(
+                wire=wm,
+                logical_bytes=float(bps * (S_ - 1)),
+                wire_bytes=float(wire_total(wm)),
+            )
         telemetry.record_program(
             ring_fp,
             kind="ring_matmul",
@@ -779,12 +892,13 @@ def matmul_raw(comm, a, b, lshape_a, lshape_b, a_split, b_split,
             schedule="ring_" + case,
             bytes_per_step=bps,
             dtype=str(comp),
+            **extra_kw,
         )
     return out
 
 
 def matmul(a, b, out_split="auto", *, epilogue: Optional[Epilogue] = None,
-           precision=None):
+           precision=None, exact: bool = False):
     """Eager DNDarray entry: ring-dispatch ``a @ b`` (2-D), returning the
     result DNDarray — or ``None`` when the dispatcher picks GSPMD (the
     caller falls back to the einsum path, keeping this function decline-
@@ -824,6 +938,7 @@ def matmul(a, b, out_split="auto", *, epilogue: Optional[Epilogue] = None,
     out = matmul_raw(
         a.comm, a.parray, b.parray, (m, k), (k, n), a.split, b.split,
         out_split, comp_dtype=comp, epilogue=epilogue, precision=precision,
+        exact=exact,
     )
     if out is None:
         return None
@@ -1005,11 +1120,37 @@ def _lower_chain(instrs, leaves, out_slot, lshapes, gshape, split, comm,
         chain_slot = op_slot
     steps = tuple(steps)
     extra_axes = _extra_axes(extra_shapes, gshape, split)
+    # wire consult (consume-only, like the ring-vs-GSPMD one above): a
+    # chain only serves forced modes or winners the eager entry already
+    # resolved on the SAME ("ring_<case>", geometry) key.  Guard-folded
+    # chains decline statically — the fold's finiteness verdict must
+    # describe the caller's numbers, not the quantized hops.
+    wire_m = ""
+    if case in ("ag", "col"):
+        if with_guard:
+            _wire.decline("ring_fold")
+        else:
+            kb_ = _ceil_mult(k, S) // S
+            bps_w = (kb_ * n if case == "ag" else m * kb_) * comp.itemsize
+            if _wire.eligible(comp, bps_w * (S - 1)):
+                wire_m = _wire.consume(
+                    "ring_" + case, (m, k, n, S, str(comp))
+                )
+    elif case == "rs":
+        _wire.decline("ring_rs")
     spec = _spec_for(
         comm, case, split, m, k, n, comp, steps, extra_axes, None,
-        fold=with_guard,
+        fold=with_guard, wire=wire_m,
     )
     kern = _build_ring(mesh, spec)
+    if wire_m:
+        kb_ = _ceil_mult(k, S) // S
+        elems = (kb_ * n if case == "ag" else m * kb_) * (S - 1)
+        _wire.account(
+            "ring_" + case, "wire_" + wire_m,
+            (kb_ * n if case == "ag" else m * kb_) * comp.itemsize * (S - 1),
+            _wire.payload_nbytes(elems, kb_ * (S - 1), wire_m),
+        )
     extra_leaf_idx = tuple(extra_of)
     _record(
         "ring_" + case, steps=S, bps=bps, out_split=split, reason=reason,

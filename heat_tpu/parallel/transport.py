@@ -71,6 +71,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core import autotune, guard, memtrack, telemetry
+from ..core import wire as _wire
 from ..analysis import program_audit, sanitize
 from .collectives import shard_map_unchecked
 
@@ -407,7 +408,14 @@ def tiled_take(
     extent ``len(rows)`` on the split axis.  The output extent is static
     (``rows.shape[0]``), so device-resident rows cost no host sync.
     RESOURCE_EXHAUSTED retries with a halved tile budget (see
-    :func:`_with_oom_backoff`)."""
+    :func:`_with_oom_backoff`).
+
+    The wire plane never quantizes this kernel: the ``psum_scatter``
+    SUMS contributions across shards, so the payload IS the data — a
+    lossy wire would corrupt the gathered rows, and masked-out lanes
+    already ride as exact zeros.  Statically declined (``wire.decline``)
+    so the decline is visible in the wire counters."""
+    _wire.decline("take")
     S = int(mesh.shape[axis_name])
     n_out = int(rows.shape[0])
     per_out = -(-n_out // S) if n_out else 1
@@ -460,7 +468,8 @@ def tiled_take(
 # ------------------------------------------------------------------ resplit
 
 
-def _build_tiled_resplit(mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles):
+def _build_tiled_resplit(mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols,
+                         n_tiles, wire=""):
     """split ``sa`` → split ``sb`` as a loop over destination-column tiles.
 
     The local slab (physical ``sa``-chunk, full logical ``sb`` extent) is
@@ -470,7 +479,17 @@ def _build_tiled_resplit(mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_t
     axis — landing each shard's canonical destination chunk directly.
     Padding along ``sa`` (the source's physical tail) rides along and is
     sliced off after the loop, so the output carries clean ``sb``-padding
-    only."""
+    only.
+
+    ``wire`` (``""`` | ``"int8"`` | ``"fp8"``) is the on-wire format
+    (round 17, ``core/wire.py``): per tile, each ``(pa, S)`` row block is
+    absmax-quantized to the narrow dtype with one f32 scale per row
+    immediately before the ``all_to_all``; the quantized payload and the
+    scale table cross the wire as a pair of collectives and the landing
+    side dequantizes into the f32-accumulated slab inside the same
+    program.  All-zero rows (the zero-pad lanes) carry scale 1 and
+    round-trip exactly, so the physical zero-pad contract survives a
+    lossy wire."""
     S = int(mesh.shape[axis_name])
     pb = -(-n_b // S)
     padded_b = n_tiles * tile_cols
@@ -488,11 +507,27 @@ def _build_tiled_resplit(mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_t
 
         def tile(t, acc):
             blk = lax.dynamic_slice_in_dim(xr, t * tile_cols, tile_cols, axis=2)
-            got = lax.all_to_all(
-                blk, axis_name, split_axis=1, concat_axis=0, tiled=True
-            )
+            if wire:
+                # scale per (pa, S) row: the quantization grain matches
+                # the all_to_all's split/concat axes, so each landed row
+                # arrives with exactly its own scale
+                q, scale = _wire.absmax_encode(blk, wire, axes=(0, 1))
+                got_q = lax.all_to_all(
+                    q, axis_name, split_axis=1, concat_axis=0, tiled=True
+                )
+                got_s = lax.all_to_all(
+                    scale, axis_name, split_axis=1, concat_axis=0, tiled=True
+                )
+                got = _wire.absmax_decode(
+                    got_q.reshape((S * pa, tile_cols) + rest),
+                    got_s.reshape((S * pa,)), (0,), xv.dtype,
+                )
+            else:
+                got = lax.all_to_all(
+                    blk, axis_name, split_axis=1, concat_axis=0, tiled=True
+                ).reshape((S * pa, tile_cols) + rest)
             return lax.dynamic_update_slice_in_dim(
-                acc, got.reshape((S * pa, tile_cols) + rest), t * tile_cols, axis=1
+                acc, got, t * tile_cols, axis=1
             )
 
         acc = jnp.zeros((S * pa, padded_b) + rest, xv.dtype)
@@ -513,10 +548,11 @@ def _build_tiled_resplit(mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_t
 
 @lru_cache(maxsize=512)
 def _jit_tiled_resplit(
-    mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles, donate
+    mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles, donate,
+    wire="",
 ):
     fn = _build_tiled_resplit(
-        mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles
+        mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles, wire
     )
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
@@ -543,16 +579,25 @@ def tiled_resplit(
     comm,
     donate: bool = False,
     tile_bytes: Optional[int] = None,
+    exact: bool = False,
 ) -> jax.Array:
     """Move ``phys`` (canonical physical layout, split ``sa``) to split
     ``sb`` through the tiled engine.  ``donate=True`` hands the input
     buffer to XLA for reuse — only pass it for buffers with no other live
     reference (in-place ``resplit_``, stage intermediates).
     RESOURCE_EXHAUSTED retries with a halved tile budget (see
-    :func:`_with_oom_backoff`)."""
+    :func:`_with_oom_backoff`).
+
+    Wire plane (round 17): large float payloads may ship absmax-quantized
+    int8/fp8 tiles instead of full-width words — the per-link format is
+    an autotune arm over ``autotune.WIRE_ARMS``, forced by
+    ``HEAT_TPU_WIRE``, and statically declined for integer/bool dtypes,
+    sub-threshold payloads, and ``exact=True`` callers (who need the
+    f32-wire bit pattern, e.g. comparison fixtures)."""
     sanitize.check_use(phys, "transport.tiled_resplit")
     S = comm.size
-    n_a, n_b = int(gshape[sa]), int(gshape[sb])
+    gshape_t = tuple(int(d) for d in gshape)
+    n_a, n_b = gshape_t[sa], gshape_t[sb]
     pa = int(phys.shape[sa]) // S
     pb = -(-n_b // S)
     itemsize = max(int(jnp.dtype(phys.dtype).itemsize), 1)
@@ -560,38 +605,105 @@ def tiled_resplit(
     for d, e in enumerate(phys.shape):
         if d not in (sa, sb):
             rest *= int(e)
+    nelem = 1
+    for d in gshape_t:
+        nelem *= d
+    logical_bytes = nelem * itemsize
 
-    def run(tb):
-        # staging unit = one destination column across (pa, S, rest)
-        tile_cols, n_tiles = tile_plan(pb, pa * S * rest * itemsize, tb)
-        fn = _jit_tiled_resplit(
-            comm.mesh, comm.split_axis, phys.ndim, int(sa), int(sb),
-            n_a, n_b, tile_cols, n_tiles, bool(donate),
-        )
-        if program_audit.enabled():
-            program_audit.audit_program(
-                "transport_resplit", fp, fn, (phys,),
-                donate=(0,) if donate else (), expect="any",
+    def _mk_run(wm, donate_arg, fp_arg):
+        def run(tb):
+            # staging unit = one destination column across (pa, S, rest)
+            tile_cols, n_tiles = tile_plan(pb, pa * S * rest * itemsize, tb)
+            fn = _jit_tiled_resplit(
+                comm.mesh, comm.split_axis, phys.ndim, int(sa), int(sb),
+                n_a, n_b, tile_cols, n_tiles, donate_arg, wm,
             )
-        return fn(phys)
+            if program_audit.enabled():
+                program_audit.audit_program(
+                    "transport_resplit", fp_arg, fn, (phys,),
+                    donate=(0,) if donate_arg else (), expect="any",
+                )
+            return fn(phys)
+
+        return run
+
+    # on-wire byte model (exact, from shapes): every logical element
+    # crosses the wire once at 1 byte, plus one f32 scale per (pa, S)
+    # row per tile per shard — computed from the same tile plan the
+    # dispatch will use
+    tile_cols0, n_tiles0 = tile_plan(pb, pa * S * rest * itemsize, tile_bytes)
+    n_scales = pa * S * n_tiles0 * S
 
     fp = None
     if telemetry.ledger_enabled():
         fp = telemetry.fingerprint(
-            ("resplit", tuple(int(d) for d in gshape), int(sa), int(sb), S,
-             str(phys.dtype)),
+            ("resplit", gshape_t, int(sa), int(sb), S, str(phys.dtype)),
         )
         # mandatory HBM traffic: read the source slab once, write the
         # destination slab once — the per-tile wire bytes are ICI
-        nelem = 1
-        for d in gshape:
-            nelem *= int(d)
         telemetry.ensure_program(
             fp, kind="transport_resplit", ops=1, flops=0.0,
             hbm_bytes=2.0 * nelem * itemsize, mesh={"devices": S},
             dtype=str(phys.dtype),
         )
-    return _with_oom_backoff("resplit", run, tile_bytes, fp=fp)
+
+    def _wire_fp(wm):
+        # separate ledger row per wire arm: the roofline report must see
+        # the compressed on-wire volume against the same logical bytes
+        if not telemetry.ledger_enabled():
+            return None
+        fpw = telemetry.fingerprint(
+            ("resplit_wire", gshape_t, int(sa), int(sb), S,
+             str(phys.dtype), wm),
+        )
+        telemetry.ensure_program(
+            fpw, kind="transport_resplit", ops=1, flops=0.0,
+            hbm_bytes=2.0 * nelem * itemsize, mesh={"devices": S},
+            dtype=str(phys.dtype), wire=wm,
+            logical_bytes=float(logical_bytes),
+            wire_bytes=float(_wire.payload_nbytes(nelem, n_scales, wm)),
+        )
+        return fpw
+
+    wire_arm, wire_d = "wire_f32", None
+    if _wire.eligible(phys.dtype, logical_bytes, exact=exact):
+        wire_arm, wire_d = _wire.choose(
+            "resplit", (gshape_t, int(sa), int(sb), S, str(phys.dtype)),
+            desc=f"resplit {gshape_t} {sa}->{sb} {phys.dtype} S={S}",
+        )
+
+    if wire_d is not None and wire_d.explore:
+        # explore: every wire arm runs under measurement (donation
+        # suppressed — the same source buffer feeds all runs) and the
+        # f32 result is returned, so numerics never depend on tuning
+        # state mid-explore
+        def run_for(wm):
+            fpx = fp if not wm else _wire_fp(wm)
+            return _with_oom_backoff(
+                "resplit", _mk_run(wm, False, fpx), tile_bytes, fp=fpx,
+            )
+
+        return _wire.explore(wire_d, run_for)
+    if wire_arm != "wire_f32":
+        wm = wire_arm[len("wire_"):]
+        fpw = _wire_fp(wm)
+        # the sampled observer keeps the degradation watch alive for
+        # table-decided arms; forced modes (wire_d None) have no table
+        observer = (
+            functools.partial(autotune.observe, wire_d.key, wire_arm)
+            if wire_d is not None else None
+        )
+        _wire.account(
+            "resplit", wire_arm, logical_bytes,
+            _wire.payload_nbytes(nelem, n_scales, wm),
+        )
+        return _with_oom_backoff(
+            "resplit", _mk_run(wm, bool(donate), fpw), tile_bytes, fp=fpw,
+            observer=observer,
+        )
+    return _with_oom_backoff(
+        "resplit", _mk_run("", bool(donate), fp), tile_bytes, fp=fp
+    )
 
 
 # ------------------------------------------------- fused elementwise tail
@@ -605,7 +717,7 @@ _FUSED_TAIL_KINDS = frozenset({"elementwise", "cast", "comparison", "predicate"}
 
 def _build_tiled_resplit_fused(
     mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles,
-    out_slot, instrs, leaf_kinds, out_dtype_str,
+    out_slot, instrs, leaf_kinds, out_dtype_str, wire="",
 ):
     """:func:`_build_tiled_resplit` with the chain's elementwise tail
     evaluated inside the tile loop: tile *k*'s compute overlaps the
@@ -676,11 +788,27 @@ def _build_tiled_resplit_fused(
             blk = env[out_slot].astype(wire_dtype)
             if src_keep is not None:
                 blk = jnp.where(src_keep, blk, jnp.zeros((), wire_dtype))
-            got = lax.all_to_all(
-                blk, axis_name, split_axis=1, concat_axis=0, tiled=True
-            )
+            if wire:
+                # the src_keep masking above already zeroed pad rows, so
+                # the quantized pad lanes carry scale 1 and round-trip
+                # as exact zeros (core/wire.py contract)
+                q, scale = _wire.absmax_encode(blk, wire, axes=(0, 1))
+                got_q = lax.all_to_all(
+                    q, axis_name, split_axis=1, concat_axis=0, tiled=True
+                )
+                got_s = lax.all_to_all(
+                    scale, axis_name, split_axis=1, concat_axis=0, tiled=True
+                )
+                got = _wire.absmax_decode(
+                    got_q.reshape((S * pa, tile_cols) + rest),
+                    got_s.reshape((S * pa,)), (0,), wire_dtype,
+                )
+            else:
+                got = lax.all_to_all(
+                    blk, axis_name, split_axis=1, concat_axis=0, tiled=True
+                ).reshape((S * pa, tile_cols) + rest)
             return lax.dynamic_update_slice_in_dim(
-                acc, got.reshape((S * pa, tile_cols) + rest), t * tile_cols, axis=1
+                acc, got, t * tile_cols, axis=1
             )
 
         acc = jnp.zeros((S * pa, padded_b) + rest, wire_dtype)
@@ -711,13 +839,13 @@ def _build_tiled_resplit_fused(
 @lru_cache(maxsize=512)
 def _jit_tiled_resplit_fused(
     mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles,
-    out_slot, instrs, leaf_kinds, out_dtype_str,
+    out_slot, instrs, leaf_kinds, out_dtype_str, wire="",
 ):
     # never donating: the leaves belong to still-pending expressions (the
     # chain may have OTHER consumers that want the old-split value)
     fn = _build_tiled_resplit_fused(
         mesh, axis_name, ndim, sa, sb, n_a, n_b, tile_cols, n_tiles,
-        out_slot, instrs, leaf_kinds, out_dtype_str,
+        out_slot, instrs, leaf_kinds, out_dtype_str, wire,
     )
     return jax.jit(fn)
 
@@ -817,21 +945,33 @@ def _lower_split_tail(
         if d not in (sa, sb):
             rest *= gshape[d]
     pb = -(-n_b // S)
+    nelem = 1
+    for d in gshape:
+        nelem *= d
+
+    # wire consult (consume-only): the fused program must not be
+    # double-executed by an explore, so this site keys on the SAME
+    # ("resplit", geometry) entry the eager engine tunes — an eager
+    # explore of the same shape warms this consult, exactly like the
+    # lazy matmul chain rides the eager ring explores.  out_dtype (the
+    # chain root, what actually crosses the wire) drives eligibility.
+    wire_m = ""
+    if _wire.eligible(root_aval.dtype, nelem * itemsize):
+        wire_m = _wire.consume(
+            "resplit", (gshape, int(sa), int(sb), S, out_dtype_str)
+        )
 
     def run(tb):
         tile_cols, n_tiles = tile_plan(pb, pa * S * rest * itemsize, tb)
         fn = _jit_tiled_resplit_fused(
             comm.mesh, comm.split_axis, ndim, int(sa), int(sb), n_a, n_b,
             tile_cols, n_tiles, int(out_slot), instrs, leaf_kinds,
-            out_dtype_str,
+            out_dtype_str, wire_m,
         )
         return fn(*leaf_vals)
 
     fp = None
     if telemetry.ledger_enabled():
-        nelem = 1
-        for d in gshape:
-            nelem *= d
         n_ops = sum(1 for ins in instrs if ins[0] == "O")
         in_bytes = sum(
             int(v.size) * int(jnp.dtype(v.dtype).itemsize)
@@ -839,15 +979,31 @@ def _lower_split_tail(
         )
         fp = telemetry.fingerprint(
             ("fused_tail", gshape, int(sa), int(sb), S, instrs,
-             out_dtype_str),
+             out_dtype_str, wire_m),
         )
         # same cost model as the fusion engine: one FLOP per output
         # element per op in the tail; HBM traffic = leaves in + slab out
+        extra = {}
+        if wire_m:
+            _, n_tiles0 = tile_plan(pb, pa * S * rest * itemsize, tile_bytes)
+            extra = dict(
+                wire=wire_m,
+                logical_bytes=float(nelem * itemsize),
+                wire_bytes=float(_wire.payload_nbytes(
+                    nelem, pa * S * n_tiles0 * S, wire_m
+                )),
+            )
         telemetry.ensure_program(
             fp, kind="fused_resplit_tail", ops=n_ops,
             flops=float(n_ops * nelem),
             hbm_bytes=float(in_bytes + nelem * itemsize),
-            mesh={"devices": S}, dtype=out_dtype_str,
+            mesh={"devices": S}, dtype=out_dtype_str, **extra,
+        )
+    if wire_m:
+        _, n_tiles0 = tile_plan(pb, pa * S * rest * itemsize, tile_bytes)
+        _wire.account(
+            "resplit_tail", "wire_" + wire_m, nelem * itemsize,
+            _wire.payload_nbytes(nelem, pa * S * n_tiles0 * S, wire_m),
         )
     out = _with_oom_backoff("resplit", run, tile_bytes, fp=fp)
     _STATS["fused_tails"] += 1
@@ -921,7 +1077,8 @@ def rechunk_plan(m_in, rowsz_in, m_out, rowsz_out, S):
     )
 
 
-def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, repack=""):
+def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk,
+                   repack="", wire=""):
     """Flat rechunk: split-0 rows of ``shape_in[1:]`` → split-0 rows of
     ``shape_out[1:]`` following a host-computed :func:`rechunk_plan`.
 
@@ -939,7 +1096,14 @@ def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, repack="")
     (``ops/repack.py``) — the narrow-minor ``kernel`` autotune arm that
     writes the output at ~1x logical bytes instead of the padded
     ~12.8x.  Bit-exact either way; the arm only changes physical
-    layout traffic."""
+    layout traffic.
+
+    ``wire`` (``""`` | ``"int8"`` | ``"fp8"``) quantizes each permuted
+    chunk on the absmax grid with ONE scalar f32 scale per chunk
+    (``core/wire.py``): payload and scale ride the same ``ppermute``
+    ring hop and the receive side dequantizes before the scatter.  Only
+    nonzero shifts quantize — the shift-0 local copy never leaves the
+    shard."""
     S = int(mesh.shape[axis_name])
     pa = -(-shape_in[0] // S)
     pb = -(-shape_out[0] // S)
@@ -965,7 +1129,13 @@ def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, repack="")
                 blk = lax.dynamic_slice_in_dim(vp, so_a[r] + cidx * ch, ch)
                 if s % S != 0:
                     perm = [(i, (i + s) % S) for i in range(S)]
-                    blk = lax.ppermute(blk, axis_name, perm=perm)
+                    if wire:
+                        q, scale = _wire.absmax_encode(blk, wire, axes=())
+                        q = lax.ppermute(q, axis_name, perm=perm)
+                        scale = lax.ppermute(scale, axis_name, perm=perm)
+                        blk = _wire.absmax_decode(q, scale, (), v.dtype)
+                    else:
+                        blk = lax.ppermute(blk, axis_name, perm=perm)
                 rs = (r - s) % S
                 i = cidx * ch + jnp.arange(ch)
                 pos = jnp.where(i < ln_a[rs], do_a[rs] + i, loc_out)
@@ -993,8 +1163,11 @@ def _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, repack="")
 
 
 @lru_cache(maxsize=512)
-def _jit_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, donate, repack=""):
-    fn = _build_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, repack)
+def _jit_rechunk(mesh, axis_name, shape_in, shape_out, plan, chunk, donate,
+                 repack="", wire=""):
+    fn = _build_rechunk(
+        mesh, axis_name, shape_in, shape_out, plan, chunk, repack, wire
+    )
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
@@ -1057,13 +1230,16 @@ def tiled_reshape(
     comm,
     tile_bytes: Optional[int] = None,
     donate: bool = False,
+    exact: bool = False,
 ) -> jax.Array:
     """Split-crossing reshape ``gin``/split ``si`` → ``gout``/split ``so``
     on physical arrays.  Stages: resplit to split-0, flat rechunk, resplit
     to ``so`` — the stage intermediates are donated; the caller's input is
     donated only with ``donate=True`` (pass it solely for buffers with no
     other live reference, e.g. a fused-tail pre-stage output the caller
-    owns).  Callers must check :func:`reshape_applicable` first."""
+    owns).  Callers must check :func:`reshape_applicable` first.
+    ``exact=True`` pins the f32 wire on every stage (see
+    :func:`tiled_resplit`)."""
     sanitize.check_use(phys, "transport.tiled_reshape")
     S = comm.size
     gin = tuple(int(d) for d in gin)
@@ -1082,7 +1258,7 @@ def tiled_reshape(
 
     if si != 0:
         phys = tiled_resplit(phys, gin, si, 0, comm, donate=donate,
-                             tile_bytes=tile_bytes)
+                             tile_bytes=tile_bytes, exact=exact)
         mid_owned = True
     else:
         mid_owned = donate
@@ -1094,12 +1270,12 @@ def tiled_reshape(
         raise ValueError("rechunk plan out of shift budget")
     itemsize = max(int(jnp.dtype(phys.dtype).itemsize), 1)
 
-    def _mk_run(repack_arm, donate_arg, phys=phys):
+    def _mk_run(repack_arm, donate_arg, wm="", phys=phys):
         def run(tb):
             chunk = max(1, tb // itemsize)
             fn = _jit_rechunk(
                 comm.mesh, comm.split_axis, gin, gout, plan, chunk,
-                donate_arg, repack_arm,
+                donate_arg, repack_arm, wm,
             )
             return fn(phys)
 
@@ -1145,9 +1321,78 @@ def tiled_reshape(
                 dtype=str(phys.dtype),
             )
 
+    # on-wire byte model for the rechunk stage (exact, from the plan):
+    # per nonzero shift, each shard ships n_ch chunk-sized blocks (the
+    # tail chunk pads to ch) plus one f32 scale per block
+    tb0 = TILE_BYTES if tile_bytes is None else int(tile_bytes)
+    chunk0 = max(1, tb0 // itemsize)
+    wire_elems = wire_scales = 0
+    for s_, _so, _do, lens in plan:
+        if s_ % S == 0:
+            continue
+        Ls = max(lens)
+        ch = min(chunk0, Ls)
+        n_ch = -(-Ls // ch)
+        wire_elems += S * n_ch * ch
+        wire_scales += S * n_ch
+    logical_moved = wire_elems * itemsize
+
+    def _wire_fp(wm):
+        if not telemetry.ledger_enabled():
+            return None
+        fpw = telemetry.fingerprint(
+            ("reshape_wire", gin, int(si), gout, int(so), S,
+             str(phys.dtype), wm),
+        )
+        telemetry.ensure_program(
+            fpw, kind="transport_reshape", ops=1, flops=0.0,
+            hbm_bytes=2.0 * nelem * itemsize, mesh={"devices": S},
+            dtype=str(phys.dtype), wire=wm,
+            logical_bytes=float(logical_moved),
+            wire_bytes=float(_wire.payload_nbytes(wire_elems, wire_scales, wm)),
+        )
+        return fpw
+
+    wire_arm, wire_d = "wire_f32", None
+    if logical_moved and _wire.eligible(phys.dtype, logical_moved,
+                                        exact=exact):
+        wire_arm, wire_d = _wire.choose(
+            "rechunk", (gin, gout, S, str(phys.dtype)),
+            desc=f"rechunk {gin}->{gout} {phys.dtype} S={S}",
+        )
+
     arm = "classic"
     key = None
-    if kmode != "off" and autotune.enabled():
+    if wire_d is not None and wire_d.explore:
+        # wire explore round: every wire arm runs the classic lowering
+        # under measurement, f32 result returned.  The repack arm stays
+        # out of this round (one tuning axis per call keeps the explore
+        # unambiguous); it gets its own consult on later f32-arm calls.
+        def run_for(wm):
+            fpx = fp if not wm else _wire_fp(wm)
+            return _with_oom_backoff(
+                "reshape", _mk_run("", False, wm), tile_bytes, fp=fpx
+            )
+
+        phys = _wire.explore(wire_d, run_for)
+        arm = "wire"
+    elif wire_arm != "wire_f32":
+        wm = wire_arm[len("wire_"):]
+        fpw = _wire_fp(wm)
+        observer = (
+            functools.partial(autotune.observe, wire_d.key, wire_arm)
+            if wire_d is not None else None
+        )
+        _wire.account(
+            "rechunk", wire_arm, logical_moved,
+            _wire.payload_nbytes(wire_elems, wire_scales, wm),
+        )
+        phys = _with_oom_backoff(
+            "reshape", _mk_run("", mid_owned, wm), tile_bytes, fp=fpw,
+            observer=observer,
+        )
+        arm = "wire"
+    elif kmode != "off" and autotune.enabled():
         key = autotune.kernel_key(
             "reshape_repack", gin, int(si), gout, int(so), S,
             str(phys.dtype),
@@ -1196,5 +1441,5 @@ def tiled_reshape(
 
     if so != 0:
         phys = tiled_resplit(phys, gout, 0, so, comm, donate=True,
-                             tile_bytes=tile_bytes)
+                             tile_bytes=tile_bytes, exact=exact)
     return phys

@@ -289,7 +289,8 @@ def cdist_quantized(x: DNDarray, qy, sqrt: bool = True) -> Optional[DNDarray]:
     )
 
 
-def _ring_cdist(x: DNDarray, y: DNDarray, xa, ya, sqrt: bool = True) -> Optional[DNDarray]:
+def _ring_cdist(x: DNDarray, y: DNDarray, xa, ya, sqrt: bool = True,
+                exact: bool = False) -> Optional[DNDarray]:
     """Ring dataflow for the both-row-split case (the reference's hand-written
     Send/Recv ring, distance.py:209, as a ``ppermute`` chain): each device
     keeps its x block stationary while y blocks rotate, so the replicated
@@ -298,18 +299,57 @@ def _ring_cdist(x: DNDarray, y: DNDarray, xa, ya, sqrt: bool = True) -> Optional
 
     Returns None (fall through to GSPMD) unless both operands are split
     along rows with mesh-divisible row counts on a multi-device mesh.
-    """
+
+    Wire plane (round 17): an eligible f32 corpus may rotate the ring
+    absmax-quantized with global per-feature scales — the same program
+    :func:`cdist_quantized` runs for an already-quantized corpus, here
+    as a ``WIRE_ARMS`` tuning decision per geometry (``core/wire.py``)
+    measured against the f32 ring."""
     comm = x.comm
     n_dev = comm.size
     if not _ring_eligible(x, y):
         return None
+    from ..core import wire as _wire
     from ..parallel.collectives import jit_shard_map_cached
 
     # xa/ya are the dtype-promoted logical arrays from _prep; with the
     # divisibility guard they coincide with the physical layout
-    out = jit_shard_map_cached(
-        _build_ring_cdist, comm.mesh, comm.split_axis, n_dev, sqrt
-    )(xa, ya)
+    mb = int(y.shape[0]) // n_dev
+    d_feat = int(y.shape[1])
+    itemsize = max(int(jnp.dtype(ya.dtype).itemsize), 1)
+    moved = mb * d_feat * (n_dev - 1) * itemsize
+
+    def run_f32():
+        return jit_shard_map_cached(
+            _build_ring_cdist, comm.mesh, comm.split_axis, n_dev, sqrt
+        )(xa, ya)
+
+    def run_q(wm):
+        # per-feature grid over the WHOLE corpus: the scales are global
+        # (replicated, O(d) bytes) so every rotating block dequantizes
+        # with the same table — identical math to cdist_quantized
+        q, scale = _wire.absmax_encode(ya, wm, (1,))
+        return jit_shard_map_cached(
+            _build_ring_cdist_q, comm.mesh, comm.split_axis, n_dev, sqrt
+        )(xa, q, scale)
+
+    wire_arm, wire_d = "wire_f32", None
+    if _wire.eligible(ya.dtype, moved, exact=exact):
+        wire_arm, wire_d = _wire.choose(
+            "cdist", (tuple(x.shape), tuple(y.shape), n_dev, str(ya.dtype)),
+            desc=f"ring cdist {tuple(x.shape)}x{tuple(y.shape)} S={n_dev}",
+        )
+    if wire_d is not None and wire_d.explore:
+        out = _wire.explore(wire_d, lambda wm: run_q(wm) if wm else run_f32())
+    elif wire_arm != "wire_f32":
+        wm = wire_arm[len("wire_"):]
+        _wire.account(
+            "cdist", wire_arm, moved,
+            _wire.payload_nbytes(mb * d_feat * (n_dev - 1), d_feat, wm),
+        )
+        out = run_q(wm)
+    else:
+        out = run_f32()
     gshape = (x.shape[0], y.shape[0])
     return DNDarray(
         out, gshape, types.canonical_heat_type(out.dtype), 0, x.device, x.comm

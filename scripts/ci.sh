@@ -106,7 +106,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/19 suite (8-device mesh)"
+say "1/20 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -115,21 +115,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/19 core subset (4-device mesh)"
+say "2/20 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/19 parity audit (exits nonzero on any gap)"
+say "3/20 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/19 multi-chip dry-run"
+say "4/20 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/19 cb smoke"
+say "5/20 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -138,10 +138,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/19 copycheck"
+say "6/20 copycheck"
 python scripts/copycheck.py
 
-say "7/19 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/20 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -157,10 +157,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/19 fusion retrace guard (second call must hit the compile cache)"
+say "8/20 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/19 guardrails (fault injection + strict-guard retrace check)"
+say "9/20 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -171,7 +171,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/19 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/20 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -179,13 +179,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/19 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/20 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/19 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/20 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -216,7 +216,7 @@ for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/19 roofline attribution + perf-regression gate"
+say "13/20 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -265,7 +265,7 @@ print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
-say "14/19 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+say "14/20 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
 # the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
 # live end-to-end forensics check: census-bearing postmortem, informed
 # first retry from measured free HBM, and the memory counter track
@@ -330,7 +330,7 @@ print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
       f"bytes, {len(counters)} counter samples")
 EOF
 
-say "15/19 autotune (explore/exploit laws + live two-process warm start)"
+say "15/20 autotune (explore/exploit laws + live two-process warm start)"
 # the self-tuning-runtime contracts (ISSUE 11) at three mesh sizes, then a
 # live warm-start check: process 1 explores, resolves winners and saves its
 # table; process 2 loads the cache at import and must do ZERO explores —
@@ -344,8 +344,12 @@ HEAT_TEST_DEVICES=4 \
 HEAT_TEST_DEVICES=1 \
   python -m pytest -q -p no:cacheprovider tests/test_autotune.py
 rm -f /tmp/ci_autotune_cache.json
+# HEAT_TPU_WIRE=off in both processes: this gate pins the MATMUL site's
+# explore arithmetic; with wire on, the winning ring arm (and the
+# resplit_(None) readbacks) would open per-link wire entries of their
+# own — the wire plane's persistence laws are stage 20's job
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events \
+HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events HEAT_TPU_WIRE=off \
 python - <<'EOF'
 import numpy as np
 import heat_tpu as ht
@@ -376,7 +380,7 @@ print(f"process 1: {st['explores']} explores, {n} winners persisted "
       f"({[r['winner'] for r in rows]})")
 EOF
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events \
+HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events HEAT_TPU_WIRE=off \
 HEAT_TPU_AUTOTUNE_CACHE=/tmp/ci_autotune_cache.json \
 python - <<'EOF'
 import numpy as np
@@ -414,7 +418,7 @@ assert not reg["regressions"], \
 print(f"autotuned check-regression OK: {len(reg['rows'])} rows judged")
 EOF
 
-say "16/19 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
+say "16/20 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
 # the kernel-tier contracts (ISSUE 12) at three mesh sizes: each test
 # scopes HEAT_TPU_PALLAS=interpret itself, so plain pytest runs suffice —
 # repack bit-exactness (incl. the pad-lane regression), fused QR panel vs
@@ -464,7 +468,7 @@ print(f"cb kernels OK: {len(rows)} rows (arms={sorted(arms)}), "
       f"{len(reg['rows'])} judged, {len(samples)} gauges")
 EOF
 
-say "17/19 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
+say "17/20 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
 # the static gate: the shipped tree must self-check clean — every
 # residual finding either fixed, inline-justified (# ht: HTxxx ok), or
 # carried in analysis/baseline.json with a human reason
@@ -502,7 +506,7 @@ else:
     raise SystemExit("planted use-after-donate was NOT caught")
 EOF_SAN
 
-say "18/19 serving front door (bucketed batching laws + live warm-started serve, meshes 8/4/1)"
+say "18/20 serving front door (bucketed batching laws + live warm-started serve, meshes 8/4/1)"
 # the serving contracts (ISSUE 14) at three mesh sizes: bucket ladder,
 # the no-retrace law under mixed concurrent traffic, every admission
 # shed reason including the injected-stall fast-fail, drain semantics,
@@ -618,7 +622,7 @@ print(f"cb serving_batch OK: {row['speedup']}x batched vs sequential, "
       f"{row['drain_flushes']} drain flushes")
 EOF
 
-say "19/19 quantized inference epilogues (int8 laws + cb rows, meshes 8/4/1)"
+say "19/20 quantized inference epilogues (int8 laws + cb rows, meshes 8/4/1)"
 # the quantize contracts (ISSUE 15) at three mesh sizes: per-channel
 # round-trip bound, shard-boundary exactness through the k-pad mask,
 # explore-returns-bf16 bitwise, HEAT_TPU_AUTOTUNE=off bit-for-bit with
@@ -661,6 +665,65 @@ assert not reg["regressions"], f"quantize regressions: {reg['regressions']}"
 arms = {n: rows[n]["arm"] for n in rows}
 ratios = {n: rows[n]["residency_ratio"] for n in rows}
 print(f"cb quantize OK: arms={arms}, residency={ratios}, "
+      f"{len(reg['rows'])} rows judged")
+EOF
+
+say "20/20 quantized collectives (wire laws + cb rows, meshes 8/4/1)"
+# the wire contracts (ISSUE 16) at three mesh sizes: the absmax/254
+# round-trip bound, off-mode bit-for-bit with zero wire-arm table
+# decisions, forced int8/fp8 through resplit / fused tail / ring matmul
+# / ring cdist with the >=3x on-wire byte law, the full decline matrix
+# (int payloads, exact=True, index gathers, the rs accumulator, the
+# below-threshold gate), tuned explore-returns-f32 + save/load
+# persistence, and the heat_tpu_wire_* exposition golden format
+python -m pytest -q -p no:cacheprovider \
+  tests/test_wire.py 2>&1 | tee /tmp/ci_wire.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_wire.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_wire.py
+# the cb wire suite end-to-end on the 8-way mesh: both movement-engine
+# rows under the forced int8 arm with the tuned arm choice recorded,
+# exact wire-ledger byte columns, and the regression gate green
+( cd benchmarks/cb && \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  HEAT_TPU_TELEMETRY=events \
+  python main.py --only wire --check-regression \
+  --out /tmp/ci_cb_wire.json )
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/ci_cb_wire.json"))
+rows = {m["name"]: m for m in doc["measurements"]}
+for want in ("resplit_wire_int8", "matmul_ring_wire"):
+    assert want in rows, f"cb wire suite missing row {want}"
+    row = rows[want]
+    assert row.get("arm"), f"{want} lacks a measured arm field"
+    assert row.get("note"), f"{want} lacks its honesty note"
+    assert row["quantized_dispatches"] > 0, row
+    # THE acceptance bar: >=3x fewer bytes on the wire, from the wire
+    # ledger's exact per-dispatch accounting, not a re-derived model
+    assert row["wire_ratio"] >= 3.0, \
+        f"{want} wire ratio under 3x: {row['wire_ratio']}"
+    assert row["wire_bytes_saved"] > 0, row
+    assert row["arm"] in ("wire_f32", "wire_int8", "wire_fp8", "exploring"), \
+        row["arm"]
+# the documented error bounds, measured not asserted-by-model: the
+# resplit moves raw elements (absmax/254 per scale row, unit-normal
+# data => well under 0.05 absolute); the matmul error is a ~k-term dot
+# of quantized operands (<1% of the output magnitude; the row's note
+# cites the bound, the gate pins a generous ceiling over it)
+assert rows["resplit_wire_int8"]["max_elem_error"] <= 0.05, \
+    rows["resplit_wire_int8"]["max_elem_error"]
+assert rows["matmul_ring_wire"]["max_elem_error"] <= 2.0, \
+    rows["matmul_ring_wire"]["max_elem_error"]
+assert rows["matmul_ring_wire"]["schedule"] == "ring_ag", \
+    rows["matmul_ring_wire"]["schedule"]
+reg = doc["regression"]
+assert reg["rows"], "check-regression attached an empty delta table"
+assert not reg["regressions"], f"wire regressions: {reg['regressions']}"
+ratios = {n: rows[n]["wire_ratio"] for n in rows}
+errs = {n: rows[n]["max_elem_error"] for n in rows}
+print(f"cb wire OK: ratios={ratios}, max_errors={errs}, "
       f"{len(reg['rows'])} rows judged")
 EOF
 

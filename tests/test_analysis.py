@@ -108,6 +108,29 @@ class TestLintRules(TestCase):
         )
         self.assertNotIn("HT001", codes)
 
+    def test_ht001_fires_on_raw_wire_threshold_parse(self):
+        # the wire plane's byte knob, parsed the forbidden way
+        codes = _codes(
+            """
+            import os
+            n = int(os.environ.get("HEAT_TPU_WIRE_MIN_BYTES", "65536"))
+            """
+        )
+        self.assertIn("HT001", codes)
+
+    def test_ht001_quiet_on_wire_module_idiom(self):
+        # mirrors heat_tpu/core/wire.py: autotune.env_bytes for the byte
+        # threshold, a plain string read for HEAT_TPU_WIRE itself
+        codes = _codes(
+            """
+            import os
+            from heat_tpu.core.autotune import env_bytes
+            n = env_bytes("HEAT_TPU_WIRE_MIN_BYTES", 64 << 10)
+            mode = os.environ.get("HEAT_TPU_WIRE", "on").strip().lower()
+            """
+        )
+        self.assertNotIn("HT001", codes)
+
     def test_ht002_fires_on_unwrapped_host_sync(self):
         for snippet in (
             "def f(x):\n    y = jnp.sum(x)\n    return float(y)\n",

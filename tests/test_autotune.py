@@ -37,20 +37,30 @@ _SMALL = ((512, 256), (256, 384))
 
 class _Tuned:
     """Scoped tuning plane: enabled via API, events level, clean
-    table/counters/recorder on both sides."""
+    table/counters/recorder on both sides.  The round-19 per-link wire
+    arms are forced OFF here: this file pins the MATMUL site's counter
+    arithmetic (explores == k, table_size == 1, ...), and a winning ring
+    arm would otherwise open its own wire entries per transfer geometry
+    — whose laws test_wire.py pins separately."""
 
     def __init__(self, level="events"):
         self.level = level
 
     def __enter__(self):
+        from heat_tpu.core import wire
+
         self.prev_level = telemetry.set_level(self.level)
         self.prev_on = autotune.set_enabled(True)
+        self.prev_wire = wire.set_mode("off")
         telemetry.reset_all()
         telemetry.clear_events()
         autotune.reset()
         return self
 
     def __exit__(self, *exc):
+        from heat_tpu.core import wire
+
+        wire.set_mode(self.prev_wire)
         autotune.set_enabled(self.prev_on)
         autotune.reset()
         telemetry.reset_all()
@@ -609,6 +619,47 @@ class TestMerge(TestCase):
             rc = autotune._main(["--merge", p1, p1, "--out", out])
             self.assertEqual(rc, 0)
             self.assertEqual(len(json.load(open(out))["entries"]), 1)
+
+    def test_wire_arm_entries_merge_and_round_trip(self):
+        # ISSUE 16: the wire arms are first-class merge citizens — fleet
+        # caches carrying ("wire_f32","wire_int8","wire_fp8") rows fold
+        # newest-best and serve back through the --merge CLI + load
+        def _wire_entry(fp, winner, best, f32=0.02):
+            return self._entry(
+                fp, winner, best,
+                {"wire_f32": [f32], "wire_int8": [best or 0.01],
+                 "wire_fp8": []},
+            )
+
+        with _Tuned(), tempfile.TemporaryDirectory() as td:
+            p1, p2, out = (
+                os.path.join(td, n) for n in ("a.json", "b.json", "m.json")
+            )
+            json.dump(self._doc([
+                _wire_entry("fp_w", "wire_int8", 0.02),
+                self._entry("fp_mm", "ring", 0.03),
+            ]), open(p1, "w"))
+            # newer + faster: the int8 wire win survives the fold
+            json.dump(self._doc([
+                _wire_entry("fp_w", "wire_int8", 0.005),
+            ]), open(p2, "w"))
+            rc = autotune._main(["--merge", p1, p2, "--out", out])
+            self.assertEqual(rc, 0)
+            doc = json.load(open(out))
+            got = {e["fingerprint"]: e for e in doc["entries"]}
+            self.assertEqual(set(got), {"fp_w", "fp_mm"})
+            self.assertEqual(got["fp_w"]["winner"], "wire_int8")
+            self.assertEqual(got["fp_w"]["best_s"], 0.005)
+            self.assertEqual(
+                set(got["fp_w"]["arms"]),
+                {"wire_f32", "wire_int8", "wire_fp8"},
+            )
+            # the merged file round-trips: the wire winner is served
+            autotune.reset()
+            self.assertEqual(autotune.load(out), 2)
+            self.assertEqual(
+                autotune.winner(("fp_w", "cpu")), "wire_int8"
+            )
 
 
 if __name__ == "__main__":
