@@ -86,6 +86,15 @@ TOLERANCE = {
     # bound, both checked by the ci.sh stage-20 gate, not the wall
     "resplit_wire_int8": 0.5,
     "matmul_ring_wire": 0.5,
+    # round-18 fleet row (router.py's own note): the wall is a 2-replica
+    # fleet ABSORBING a real injected 0.35s replica stall mid-run — the
+    # timed region includes the stall, the ejection and the failover
+    # re-dispatches, and on the CPU CI mesh both replicas contend for
+    # the same host cores under 8 submitter threads, so scheduler noise
+    # rides the number; the headline the row vouches for is
+    # lost_futures=0 and the measured recovery tail, both asserted
+    # inside the workload itself
+    "router_failover": 0.5,
 }
 
 _ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")

@@ -18,6 +18,7 @@ import manipulations
 import nn
 import quantize
 import regression
+import router
 import serving
 import wire
 
@@ -93,7 +94,7 @@ if __name__ == "__main__":
         default=None,
         help="comma-separated subset: "
              "linalg,cluster,manipulations,nn,regression,fusion,kernels,"
-             "serving,quantize,wire",
+             "serving,router,quantize,wire",
     )
     ap.add_argument(
         "--check-regression",
@@ -114,6 +115,7 @@ if __name__ == "__main__":
         "nn": nn.run,
         "quantize": quantize.run,
         "regression": regression.run,
+        "router": router.run,
         "serving": serving.run,
         "wire": wire.run,
     }

@@ -108,6 +108,7 @@ __all__ = [
     "reset_group",
     "reset_programs",
     "roofline_report",
+    "router_report",
     "serving_report",
     "set_capacity",
     "set_level",
@@ -1032,6 +1033,19 @@ def serving_report() -> dict:
     if "serving" not in _GROUPS:
         return {}
     return snapshot_group("serving")
+
+
+def router_report() -> dict:
+    """Snapshot of the ``router`` counter group (registered by
+    :mod:`heat_tpu.serving.router` on import): dispatch/spill/failover/
+    retry counters, circuit-breaker transitions (ejections, half-opens,
+    probes, recoveries) and rolling-swap outcomes.  Empty dict until the
+    fleet router has been imported — surfaced here so the ops story
+    (``snapshot()`` / ``serving_report()`` / ``router_report()``) lives
+    behind one module."""
+    if "router" not in _GROUPS:
+        return {}
+    return snapshot_group("router")
 
 
 def reset() -> None:

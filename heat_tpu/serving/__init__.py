@@ -15,22 +15,35 @@ Three layers, one module each:
   (flush on full bucket / latency deadline / drain);
 * :mod:`~heat_tpu.serving.engine` — endpoint registry, power-of-two
   bucket ladders, compile-once step cache, telemetry;
-* :mod:`~heat_tpu.serving.admission` — bounded queue depth, HBM- and
-  stall-aware load shedding (:class:`RequestRejected`), graceful drain.
+* :mod:`~heat_tpu.serving.admission` — bounded queue depth, HBM-,
+  stall- and SLO-class-aware load shedding (:class:`RequestRejected`),
+  graceful drain;
+* :mod:`~heat_tpu.serving.router` — the fleet layer (ISSUE 18): N
+  health-checked replicas behind a consistent-hash ring, circuit
+  breaker with half-open probes, bounded retry/failover, and
+  zero-downtime rolling weight swaps:
 
-Importing the package registers the ``serving`` telemetry group; see
-``docs/quick_start.md`` §13 for the end-to-end walkthrough.
+>>> fleet = serving.ServingFleet(replicas=4)
+>>> fleet.register("kmeans", models=replica_models, feature_dim=32)
+>>> fleet.rolling_swap("kmeans", {"w": new_w}, canary=1)
+
+Importing the package registers the ``serving`` and ``router``
+telemetry groups; see ``docs/quick_start.md`` §13/§16 for the
+end-to-end walkthroughs.
 """
 
 from .admission import AdmissionController, RequestRejected
 from .batcher import DynamicBatcher, Request
 from .engine import Endpoint, ServingEngine
+from .router import Replica, ServingFleet
 
 __all__ = [
     "AdmissionController",
     "DynamicBatcher",
     "Endpoint",
+    "Replica",
     "Request",
     "RequestRejected",
     "ServingEngine",
+    "ServingFleet",
 ]

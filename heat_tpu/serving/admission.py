@@ -20,6 +20,13 @@ signals feed the decision:
   ``"resume"``, so a wedged mesh fails requests in microseconds instead
   of letting them hang behind a dead queue.  Push, not poll.
 
+Round 20 adds **SLO classes**: every request carries a ``priority``
+(``"high"`` / ``"normal"`` / ``"low"`` by default) and each class rides a
+fraction of the queue bound (:data:`DEFAULT_CLASS_THRESHOLDS`).  Under
+pressure the low class hits its smaller bound first — low-priority work
+sheds before paying traffic feels anything — while ``high``/``normal``
+keep the full bound, so the single-class behaviour is unchanged.
+
 Shutdown is two-phase: :meth:`begin_drain` sheds *new* work
 (``draining``) while queued work finishes; :meth:`close` sheds
 everything (``closed``).
@@ -32,7 +39,13 @@ from typing import Any, Dict, Optional
 
 from ..core import memtrack, telemetry
 
-__all__ = ["AdmissionController", "RequestRejected"]
+__all__ = ["AdmissionController", "DEFAULT_CLASS_THRESHOLDS", "RequestRejected"]
+
+#: SLO classes and the fraction of ``max_queue_rows`` each may fill.
+#: ``high`` and ``normal`` ride the full bound (so a fleet of one class
+#: behaves exactly like the pre-SLO gate); ``low`` is shed once the
+#: queue passes half — under pressure, low-priority work goes first.
+DEFAULT_CLASS_THRESHOLDS = {"high": 1.0, "normal": 1.0, "low": 0.5}
 
 
 class RequestRejected(RuntimeError):
@@ -79,6 +92,7 @@ class AdmissionController:
         retry_after_s: float = 0.05,
         memory_fraction: float = 0.5,
         memory_headroom: int = 0,
+        class_thresholds: Optional[Dict[str, float]] = None,
     ):
         if max_queue_rows < 1:
             raise ValueError(f"max_queue_rows must be >= 1, got {max_queue_rows}")
@@ -86,6 +100,15 @@ class AdmissionController:
         self.retry_after_s = float(retry_after_s)
         self.memory_fraction = float(memory_fraction)
         self.memory_headroom = int(memory_headroom)
+        thresholds = dict(DEFAULT_CLASS_THRESHOLDS)
+        if class_thresholds:
+            thresholds.update(class_thresholds)
+        for cls, fraction in thresholds.items():
+            if not 0.0 < float(fraction) <= 1.0:
+                raise ValueError(
+                    f"class threshold for {cls!r} must be in (0, 1], got {fraction}"
+                )
+        self.class_thresholds = {c: float(f) for c, f in thresholds.items()}
         self._lock = threading.Lock()
         self._queued_rows = 0
         self._stalled = False
@@ -137,10 +160,22 @@ class AdmissionController:
 
     # -- the decision ---------------------------------------------------
 
-    def admit(self, endpoint: str, rows: int, nbytes: int) -> None:
+    def admit(
+        self, endpoint: str, rows: int, nbytes: int, *, priority: str = "normal"
+    ) -> None:
         """Admit ``rows`` request rows (``nbytes`` of staging) for
-        ``endpoint`` or raise :class:`RequestRejected`."""
+        ``endpoint`` or raise :class:`RequestRejected`.
+
+        ``priority`` selects the SLO class: the queue bound scales by
+        the class's threshold, so under pressure classes below 1.0 shed
+        first.  An unknown class is a programming error (``ValueError``),
+        not load shedding."""
         rows = int(rows)
+        threshold = self.class_thresholds.get(priority)
+        if threshold is None:
+            raise ValueError(
+                f"unknown SLO class {priority!r}; known: {sorted(self.class_thresholds)}"
+            )
         with self._lock:
             if self._closed:
                 raise RequestRejected("closed", None, "serving engine is closed")
@@ -154,13 +189,18 @@ class AdmissionController:
                     self.retry_after_s,
                     "mesh stall detected — failing fast instead of queueing behind it",
                 )
-            if self._queued_rows + rows > self.max_queue_rows:
-                raise RequestRejected(
-                    "queue_full",
-                    self.retry_after_s,
+            bound = int(self.max_queue_rows * threshold)
+            if self._queued_rows + rows > bound:
+                detail = (
                     f"{self._queued_rows} rows queued + {rows} requested "
-                    f"> bound {self.max_queue_rows}",
+                    f"> bound {bound}"
                 )
+                if threshold < 1.0:
+                    detail += (
+                        f" (class {priority!r} rides {threshold:g} of "
+                        f"{self.max_queue_rows} — lower classes shed first)"
+                    )
+                raise RequestRejected("queue_full", self.retry_after_s, detail)
             fits = memtrack.would_fit(
                 int(nbytes),
                 fraction=self.memory_fraction,

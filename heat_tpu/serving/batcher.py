@@ -38,13 +38,19 @@ class Request:
 
     ``payload`` is the host-side (rows, feature_dim) array, ``deadline``
     the absolute ``time.perf_counter()`` instant after which the flush
-    timer fires, ``t0`` the submit instant for the latency histogram."""
+    timer fires, ``t0`` the submit instant for the latency histogram.
+    ``priority`` is the SLO class the admission gate admitted under;
+    ``client_deadline`` (absolute, or ``None``) is the *caller's*
+    deadline — a request still queued when it lapses is shed at flush
+    (``expired``) instead of computing an answer nobody is waiting for."""
 
     endpoint: str
     payload: Any
     rows: int
     t0: float
     deadline: float
+    priority: str = "normal"
+    client_deadline: Optional[float] = None
     future: Future = field(default_factory=Future)
 
 
@@ -153,6 +159,21 @@ class DynamicBatcher:
     def pending_requests(self) -> int:
         with self._cond:
             return sum(len(q) for q in self._queues.values())
+
+    def busy(self) -> int:
+        """Queued requests plus in-flight batches.  Zero means the worker
+        is legitimately idle (no heartbeats expected); nonzero while a
+        stall detector fires means work is actually wedged — the fleet
+        router uses this to tell a quiet replica from a dead one."""
+        with self._cond:
+            return sum(len(q) for q in self._queues.values()) + self._in_flight
+
+    def in_flight(self) -> int:
+        """Batches currently executing.  Queued-but-unflushed requests
+        don't count: a queue waiting out ``max_delay_s`` is batching
+        latency, not a wedged step, and must not trip the breaker."""
+        with self._cond:
+            return self._in_flight
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Flush every queue (cause ``"drain"``) and wait for in-flight

@@ -36,11 +36,11 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass
-from typing import Any, Callable, Deque, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core import factories, telemetry
+from ..core import factories, guard, telemetry
 from .admission import AdmissionController, RequestRejected
 from .batcher import DynamicBatcher, Request
 
@@ -82,6 +82,8 @@ _STATS = telemetry.register_group(
         "padded_rows": 0,
         "step_compiles": 0,
         "step_hits": 0,
+        "step_errors": 0,
+        "swaps": 0,
         "drains": 0,
         "flush_cause": {"max_batch": 0, "timer": 0, "drain": 0},
         "shed": {
@@ -91,11 +93,20 @@ _STATS = telemetry.register_group(
             "draining": 0,
             "closed": 0,
             "too_large": 0,
+            "expired": 0,
         },
+        "accepted_by_class": {"high": 0, "normal": 0, "low": 0},
+        "shed_by_class": {"high": 0, "normal": 0, "low": 0},
     },
     extra=lambda: {"latency": _latency_view()},
     on_reset=_LATENCIES.clear,
 )
+
+
+def _bump(counter: Dict[str, int], key: str) -> None:
+    # class counters grow with operator-configured SLO classes; the
+    # three defaults are pre-registered so gauges exist from process start
+    counter[key] = counter.get(key, 0) + 1
 
 
 def _mesh_size() -> int:
@@ -122,6 +133,48 @@ def _pow2_buckets(min_bucket: int, max_batch: int) -> Tuple[int, ...]:
     return tuple(ladder)
 
 
+def _dtype_name(dt: Any) -> str:
+    # numpy parses its own dtypes; heat's type *classes* parse as
+    # dtype('O'), so fall back to the class name ("float32")
+    try:
+        parsed = np.dtype(dt)
+        if parsed != np.dtype(object):
+            return parsed.name
+    except TypeError:
+        pass
+    return getattr(dt, "__name__", str(dt))
+
+
+def _check_swap_compat(endpoint: str, key: str, cur: Any, new: Any) -> None:
+    """Refuse operand swaps that would change the step's traced shapes.
+
+    The round-18 law: a republished checkpoint is *new operands, not a
+    retrace*.  A shape/dtype/split change recompiles every bucket step,
+    so it is rejected here instead of silently blowing the caches."""
+    cur_shape = tuple(getattr(cur, "shape", ()) or ())
+    new_shape = tuple(getattr(new, "shape", ()) or ())
+    if cur_shape != new_shape:
+        raise ValueError(
+            f"swap_weights({endpoint!r}): operand {key!r} shape {new_shape} "
+            f"!= resident {cur_shape} — a shape change retraces every bucket "
+            "step; register a new endpoint instead"
+        )
+    cur_dt, new_dt = getattr(cur, "dtype", None), getattr(new, "dtype", None)
+    if cur_dt is not None and new_dt is not None:
+        if _dtype_name(cur_dt) != _dtype_name(new_dt):
+            raise ValueError(
+                f"swap_weights({endpoint!r}): operand {key!r} dtype "
+                f"{_dtype_name(new_dt)} != resident {_dtype_name(cur_dt)} — "
+                "a dtype change is a retrace"
+            )
+    if getattr(cur, "split", None) != getattr(new, "split", None):
+        raise ValueError(
+            f"swap_weights({endpoint!r}): operand {key!r} split "
+            f"{getattr(new, 'split', None)} != resident "
+            f"{getattr(cur, 'split', None)} — a resharding is a retrace"
+        )
+
+
 @dataclass(frozen=True)
 class Endpoint:
     """One registered predict surface with its frozen shape contract."""
@@ -133,6 +186,7 @@ class Endpoint:
     split: Optional[int]
     buckets: Tuple[int, ...]
     max_delay_s: float
+    model: Any = None
 
     @property
     def max_batch(self) -> int:
@@ -165,17 +219,28 @@ class ServingEngine:
     def __init__(
         self,
         *,
+        name: str = "",
         admission: Optional[AdmissionController] = None,
         stall_detector=None,
         default_max_delay_s: float = 0.005,
     ):
+        # a name marks this engine as one replica of a fleet: its latency
+        # reservoirs are keyed "<name>:<endpoint>" so the router can route
+        # on *this* replica's percentiles, not a fleet-wide blur
+        self.name = str(name)
         self._endpoints: Dict[str, Endpoint] = {}
         self._steps: Dict[Tuple[str, int], _Step] = {}
         self._lock = threading.Lock()
+        self._swap_lock = threading.Lock()
         self._closed = False
         self.default_max_delay_s = float(default_max_delay_s)
         self.admission = admission if admission is not None else AdmissionController()
-        self._batcher = DynamicBatcher(self._execute)
+        self._batcher = DynamicBatcher(
+            self._execute,
+            name=f"heat-tpu-serving-batcher-{self.name}"
+            if self.name
+            else "heat-tpu-serving-batcher",
+        )
         self._detector = None
         if stall_detector is not None:
             self.attach_stall_detector(stall_detector)
@@ -247,6 +312,7 @@ class ServingEngine:
             split=split,
             buckets=buckets,
             max_delay_s=self.default_max_delay_s if max_delay_s is None else float(max_delay_s),
+            model=model,
         )
         with self._lock:
             self._endpoints[name] = endpoint
@@ -275,14 +341,73 @@ class ServingEngine:
             step.run(np.zeros((bucket, endpoint.feature_dim), dtype=endpoint.dtype))
         return len(endpoint.buckets)
 
+    def swap_weights(self, name: str, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Hot-swap endpoint ``name``'s model operands under live traffic.
+
+        ``params`` maps model attribute names to replacement operands
+        (e.g. ``{"w": new_weights}``).  A model exposing
+        ``swap_weights_(params) -> old_params`` owns the exchange itself;
+        otherwise attributes are validated then assigned.  Shapes, dtypes
+        and splits must match the resident operands — a mismatch would
+        retrace every bucket step, so it is refused with ``ValueError``
+        (round-18 law: a republished checkpoint is new operands, not a
+        retrace — **zero step compiles**).  Returns the old operand
+        values for rollback.  The exchange happens under the step lock:
+        a mid-flight batch sees all-old or all-new weights, never a mix."""
+        endpoint = self._endpoint(name)
+        model = endpoint.model
+        if model is None:
+            raise ValueError(
+                f"endpoint {name!r} was registered with a bare predict "
+                "callable — weight swaps need `model=`"
+            )
+        if not params:
+            raise ValueError("swap_weights needs at least one operand")
+        with self._swap_lock:
+            hook = getattr(model, "swap_weights_", None)
+            if hook is not None:
+                old = hook(params)
+            else:
+                old = {}
+                for key, new in params.items():
+                    if not hasattr(model, key):
+                        raise ValueError(
+                            f"swap_weights({name!r}): model has no operand {key!r}"
+                        )
+                    cur = getattr(model, key)
+                    _check_swap_compat(name, key, cur, new)
+                    old[key] = cur
+                for key, new in params.items():
+                    setattr(model, key, new)
+        _STATS["swaps"] += 1
+        telemetry.record_event(
+            "serving_swap", endpoint=name, engine=self.name, params=sorted(params)
+        )
+        return old
+
     # -- request path ---------------------------------------------------
 
-    def submit(self, name: str, x: Any) -> Future:
+    def submit(
+        self,
+        name: str,
+        x: Any,
+        *,
+        priority: str = "normal",
+        deadline_s: Optional[float] = None,
+    ) -> Future:
         """Admit + queue one request; resolves to the caller's rows only.
+
+        ``priority`` picks the SLO class (``"high"``/``"normal"``/
+        ``"low"`` by default — low sheds first under queue pressure) and
+        ``deadline_s`` sets the *client* deadline: a request still queued
+        when it lapses is shed at flush (reason ``expired``) instead of
+        computing an answer nobody is waiting for.
 
         Raises :class:`~heat_tpu.serving.admission.RequestRejected` when
         shed — the documented fast-fail, never a hang."""
         endpoint = self._endpoint(name)
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         batch = np.asarray(x, dtype=endpoint.dtype)
         if batch.ndim == 1:
             batch = batch.reshape(1, -1)
@@ -304,19 +429,22 @@ class ServingEngine:
                     f"{rows} rows exceed endpoint max batch {endpoint.max_batch} "
                     "(split oversized requests client-side)",
                 )
-            self.admission.admit(name, rows, batch.nbytes)
+            self.admission.admit(name, rows, batch.nbytes, priority=priority)
         except RequestRejected as exc:
             _STATS["rejected"] += 1
             _STATS["shed"][exc.reason] += 1
+            _bump(_STATS["shed_by_class"], priority)
             telemetry.record_event(
                 "serving_shed",
                 endpoint=name,
                 reason=exc.reason,
                 rows=rows,
+                priority=priority,
                 retry_after_s=exc.retry_after_s,
             )
             raise
         _STATS["accepted"] += 1
+        _bump(_STATS["accepted_by_class"], priority)
         now = time.perf_counter()
         request = Request(
             endpoint=name,
@@ -324,13 +452,28 @@ class ServingEngine:
             rows=rows,
             t0=now,
             deadline=now + endpoint.max_delay_s,
+            priority=priority,
+            client_deadline=None if deadline_s is None else now + float(deadline_s),
         )
         self._batcher.enqueue(request, endpoint.max_batch)
         return request.future
 
-    def predict(self, name: str, x: Any, timeout: Optional[float] = 30.0) -> np.ndarray:
-        """Blocking convenience: ``submit(...).result(timeout)``."""
-        return self.submit(name, x).result(timeout)
+    def predict(
+        self,
+        name: str,
+        x: Any,
+        timeout: Optional[float] = 30.0,
+        *,
+        priority: str = "normal",
+    ) -> np.ndarray:
+        """Blocking convenience: ``submit(...).result(timeout)``.
+
+        The timeout doubles as the client deadline — a request that
+        cannot flush in time is shed ``expired`` at flush, not left
+        queued (and admitted) behind the caller's back."""
+        return self.submit(name, x, priority=priority, deadline_s=timeout).result(
+            timeout
+        )
 
     # -- batch execution (batcher worker thread) ------------------------
 
@@ -381,10 +524,48 @@ class ServingEngine:
         )
         return step
 
+    def _drop_expired(self, name: str, requests: Sequence[Request]) -> List[Request]:
+        """Shed requests whose *client* deadline lapsed while queued —
+        their callers have already timed out, so computing them is dead
+        work that only adds latency for live requests behind them."""
+        now = time.perf_counter()
+        live: List[Request] = []
+        for request in requests:
+            if request.client_deadline is None or now < request.client_deadline:
+                live.append(request)
+                continue
+            try:
+                request.future.set_exception(
+                    RequestRejected(
+                        "expired",
+                        None,
+                        f"client deadline passed "
+                        f"{now - request.client_deadline:.3f}s before flush",
+                    )
+                )
+            except InvalidStateError:
+                pass
+            self.admission.release(request.rows)
+            _STATS["shed"]["expired"] += 1
+            _bump(_STATS["shed_by_class"], request.priority)
+            telemetry.record_event(
+                "serving_expired",
+                endpoint=name,
+                rows=request.rows,
+                priority=request.priority,
+            )
+        return live
+
     def _execute(self, name: str, requests: Sequence[Request], cause: str) -> None:
         endpoint = self._endpoint(name)
+        requests = self._drop_expired(name, requests)
+        if not requests:
+            return
         rows = sum(r.rows for r in requests)
         try:
+            guard.fire("serving.step")
+            if self.name:
+                guard.fire(f"serving.step.{self.name}")
             bucket = endpoint.bucket_for(rows)
             batch = np.zeros((bucket, endpoint.feature_dim), dtype=endpoint.dtype)
             offset = 0
@@ -404,7 +585,10 @@ class ServingEngine:
                 cause=cause,
             ):
                 t0 = time.perf_counter()
-                out = step.run(batch)
+                # swaps exchange operands under this lock, so a batch
+                # reads either all-old or all-new weights — never a tear
+                with self._swap_lock:
+                    out = step.run(batch)
                 duration = time.perf_counter() - t0
             telemetry.record_timing(step.fingerprint, duration)
             telemetry.program_hit(step.fingerprint)
@@ -415,11 +599,24 @@ class ServingEngine:
                 except InvalidStateError:
                     pass
             self.admission.release(rows)
-            telemetry.record_event("serving_error", endpoint=name, error=repr(exc))
+            _STATS["step_errors"] += 1
+            telemetry.record_event(
+                "serving_error", endpoint=name, engine=self.name, error=repr(exc)
+            )
+            # a failing step is liveness, not a stall: this worker is
+            # alive and resolving futures.  Without the beat, a burst of
+            # consecutive step errors latched `stalled` (no successful
+            # batch ever called note_progress) and shed all traffic from
+            # a live worker until one batch happened to succeed.
+            self.admission.note_progress()
+            if self._detector is not None:
+                self._detector.beat()
             return
         offset = 0
         done = time.perf_counter()
-        reservoir = _LATENCIES.setdefault(name, deque(maxlen=_LATENCY_SAMPLES))
+        reservoir = _LATENCIES.setdefault(
+            self._lat_key(name), deque(maxlen=_LATENCY_SAMPLES)
+        )
         for request in requests:
             try:
                 request.future.set_result(out[offset : offset + request.rows])
@@ -434,6 +631,31 @@ class ServingEngine:
             self._detector.beat()
 
     # -- introspection / lifecycle --------------------------------------
+
+    def _lat_key(self, name: str) -> str:
+        return f"{self.name}:{name}" if self.name else name
+
+    def latency(self, name: str) -> Optional[Dict[str, float]]:
+        """This engine's p50/p99 reservoir snapshot for endpoint ``name``
+        (``None`` before the first served batch) — named engines (fleet
+        replicas) keep per-replica reservoirs, so the router routes on
+        each replica's own percentiles."""
+        return _latency_view().get(self._lat_key(name))
+
+    def busy(self) -> int:
+        """Queued + in-flight work (see :meth:`DynamicBatcher.busy`)."""
+        return self._batcher.busy()
+
+    def in_flight(self) -> int:
+        """Batches executing right now (queued rows excluded — see
+        :meth:`DynamicBatcher.in_flight`)."""
+        return self._batcher.in_flight()
+
+    @property
+    def detector(self):
+        """The attached :class:`~heat_tpu.utils.fault.StallDetector`
+        (``None`` when running without a watchdog)."""
+        return self._detector
 
     def stats(self) -> Dict[str, Any]:
         """Live ``serving`` counter snapshot incl. latency percentiles."""

@@ -28,7 +28,14 @@ sharded checkpoints of :mod:`heat_tpu.utils.checkpointing`:
   injector (:func:`install_injector` / :func:`injected`) wires it into the
   ``heat_tpu.core.guard`` hooks those subsystems consult on every attempt,
   so OOM backoff, eager fallback, and stall detection are all exercised by
-  faults raised at their real call sites.
+  faults raised at their real call sites.  Round 20 adds the serving
+  sites: ``serving.step`` (and ``serving.step.<engine>`` for one named
+  fleet replica) is consulted by the serving worker before every batch,
+  and ``serving.replica`` / ``serving.replica.<name>`` by the fleet
+  router on every dispatch — so replica failover, circuit-open, and
+  half-open-probe recovery are tested with real injected faults, not
+  mocks.  A site key ending in ``.*`` arms every site under that prefix
+  (``serving.step.*`` hits whichever replica flushes next).
 
 Multi-host note: each host runs the same supervised loop SPMD-style; a
 restore after a full-job restart resumes from the same sharded checkpoint
@@ -180,9 +187,22 @@ class FaultInjector:
         ]
         return self
 
+    def _pending(self, site: str) -> Optional[List[tuple]]:
+        """Armed queue for ``site``: exact match first, then a prefix
+        wildcard — arming ``"serving.step.*"`` fires for any
+        replica-scoped site (``serving.step.r3``) so fleet tests target
+        one replica or all of them without enumerating engine names."""
+        queue = self._sites.get(site)
+        if queue:
+            return queue
+        for key, pending in self._sites.items():
+            if key.endswith(".*") and pending and site.startswith(key[:-1]):
+                return pending
+        return None
+
     def fire_site(self, site: str) -> None:
         """Hook target for :func:`heat_tpu.core.guard.fire`."""
-        queue = self._sites.get(site)
+        queue = self._pending(site)
         if not queue or queue[0][0] not in ("oom", "error", "stall"):
             return
         kind, payload = queue.pop(0)
@@ -195,7 +215,7 @@ class FaultInjector:
 
     def corrupt_site(self, site: str, value):
         """Hook target for :func:`heat_tpu.core.guard.corrupt`."""
-        queue = self._sites.get(site)
+        queue = self._pending(site)
         if not queue or queue[0][0] != "nan":
             return value
         queue.pop(0)

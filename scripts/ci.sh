@@ -94,6 +94,22 @@
 #                 the cb quantize suite end-to-end — its three rows must
 #                 land with a measured arm AND >=3x exact-ledger HBM
 #                 residency vs the f32 master — under the regression gate
+#  20. wire      — quantized collectives (ISSUE 16): the wire test file
+#                 at meshes 8/4/1 (round-trip bound, off-mode bitwise,
+#                 decline matrix, per-link arm persistence), then the cb
+#                 wire suite with the >=3x on-wire byte law and measured
+#                 error bounds under the regression gate
+#  21. router    — fault-tolerant fleet serving (ISSUE 18): the router
+#                 failure matrix at meshes 8/4/1 (consistent-hash
+#                 placement, stall/error-burst ejection + half-open
+#                 probe recovery, bounded retry/failover, SLO shed
+#                 ordering + expired deadlines, rolling swaps with
+#                 canary rollback under the no-retrace law), then a live
+#                 fault drill — a replica stalls mid-step under
+#                 mixed-priority traffic against a squeezed queue: every
+#                 high/normal request must be served via failover, `low`
+#                 sheds first in the per-class ledger, zero lost
+#                 futures, and the heat_tpu_router_* gauges must parse
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -106,7 +122,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/20 suite (8-device mesh)"
+say "1/21 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -115,21 +131,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/20 core subset (4-device mesh)"
+say "2/21 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/20 parity audit (exits nonzero on any gap)"
+say "3/21 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/20 multi-chip dry-run"
+say "4/21 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/20 cb smoke"
+say "5/21 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -138,10 +154,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/20 copycheck"
+say "6/21 copycheck"
 python scripts/copycheck.py
 
-say "7/20 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/21 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -157,10 +173,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/20 fusion retrace guard (second call must hit the compile cache)"
+say "8/21 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/20 guardrails (fault injection + strict-guard retrace check)"
+say "9/21 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -171,7 +187,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/20 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/21 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -179,13 +195,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/20 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/21 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/20 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/21 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -216,7 +232,7 @@ for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/20 roofline attribution + perf-regression gate"
+say "13/21 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -265,7 +281,7 @@ print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
-say "14/20 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+say "14/21 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
 # the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
 # live end-to-end forensics check: census-bearing postmortem, informed
 # first retry from measured free HBM, and the memory counter track
@@ -330,7 +346,7 @@ print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
       f"bytes, {len(counters)} counter samples")
 EOF
 
-say "15/20 autotune (explore/exploit laws + live two-process warm start)"
+say "15/21 autotune (explore/exploit laws + live two-process warm start)"
 # the self-tuning-runtime contracts (ISSUE 11) at three mesh sizes, then a
 # live warm-start check: process 1 explores, resolves winners and saves its
 # table; process 2 loads the cache at import and must do ZERO explores —
@@ -418,7 +434,7 @@ assert not reg["regressions"], \
 print(f"autotuned check-regression OK: {len(reg['rows'])} rows judged")
 EOF
 
-say "16/20 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
+say "16/21 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
 # the kernel-tier contracts (ISSUE 12) at three mesh sizes: each test
 # scopes HEAT_TPU_PALLAS=interpret itself, so plain pytest runs suffice —
 # repack bit-exactness (incl. the pad-lane regression), fused QR panel vs
@@ -468,7 +484,7 @@ print(f"cb kernels OK: {len(rows)} rows (arms={sorted(arms)}), "
       f"{len(reg['rows'])} judged, {len(samples)} gauges")
 EOF
 
-say "17/20 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
+say "17/21 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
 # the static gate: the shipped tree must self-check clean — every
 # residual finding either fixed, inline-justified (# ht: HTxxx ok), or
 # carried in analysis/baseline.json with a human reason
@@ -506,7 +522,7 @@ else:
     raise SystemExit("planted use-after-donate was NOT caught")
 EOF_SAN
 
-say "18/20 serving front door (bucketed batching laws + live warm-started serve, meshes 8/4/1)"
+say "18/21 serving front door (bucketed batching laws + live warm-started serve, meshes 8/4/1)"
 # the serving contracts (ISSUE 14) at three mesh sizes: bucket ladder,
 # the no-retrace law under mixed concurrent traffic, every admission
 # shed reason including the injected-stall fast-fail, drain semantics,
@@ -622,7 +638,7 @@ print(f"cb serving_batch OK: {row['speedup']}x batched vs sequential, "
       f"{row['drain_flushes']} drain flushes")
 EOF
 
-say "19/20 quantized inference epilogues (int8 laws + cb rows, meshes 8/4/1)"
+say "19/21 quantized inference epilogues (int8 laws + cb rows, meshes 8/4/1)"
 # the quantize contracts (ISSUE 15) at three mesh sizes: per-channel
 # round-trip bound, shard-boundary exactness through the k-pad mask,
 # explore-returns-bf16 bitwise, HEAT_TPU_AUTOTUNE=off bit-for-bit with
@@ -668,7 +684,7 @@ print(f"cb quantize OK: arms={arms}, residency={ratios}, "
       f"{len(reg['rows'])} rows judged")
 EOF
 
-say "20/20 quantized collectives (wire laws + cb rows, meshes 8/4/1)"
+say "20/21 quantized collectives (wire laws + cb rows, meshes 8/4/1)"
 # the wire contracts (ISSUE 16) at three mesh sizes: the absmax/254
 # round-trip bound, off-mode bit-for-bit with zero wire-arm table
 # decisions, forced int8/fp8 through resplit / fused tail / ring matmul
@@ -725,6 +741,129 @@ ratios = {n: rows[n]["wire_ratio"] for n in rows}
 errs = {n: rows[n]["max_elem_error"] for n in rows}
 print(f"cb wire OK: ratios={ratios}, max_errors={errs}, "
       f"{len(reg['rows'])} rows judged")
+EOF
+
+say "21/21 fleet router (failure matrix meshes 8/4/1 + live fault drill)"
+# the fleet contracts (ISSUE 18) at three mesh sizes: consistent-hash
+# affinity, the full failure matrix (mid-step stall -> eject + failover
+# with zero lost futures, error burst -> circuit -> half-open probe
+# recovery, dispatch-site faults, queue-full backoff against the retry
+# budget, all-ejected -> documented unavailable -> probe re-entry), SLO
+# shed ordering + lapsed-deadline expiry, and rolling swaps under
+# traffic (no-retrace law, canary regression -> rollback with the old
+# weights still serving)
+python -m pytest -q -p no:cacheprovider \
+  tests/test_router.py 2>&1 | tee /tmp/ci_router.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_router.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_router.py
+# live fault drill: one replica of three stalls mid-step for a full
+# second while mixed-priority traffic arrives against a deliberately
+# squeezed queue — the breaker ejects it, in-flight victims fail over,
+# every high/normal request is SERVED, only `low` may shed terminally
+# (and the per-class ledger must show it shedding first), the stalled
+# replica re-enters through a half-open probe, and every
+# heat_tpu_router_* gauge parses out of the Prometheus exposition
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+HEAT_TPU_TELEMETRY=events \
+python - <<'EOF'
+import time
+import numpy as np
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.core import telemetry
+from heat_tpu.serving import RequestRejected
+from heat_tpu.serving.router import HEALTHY
+from heat_tpu.utils import fault
+
+F, O = 16, 4
+rng = np.random.default_rng(21)
+
+class Linear:
+    def __init__(self, w):
+        self.w = ht.array(w, split=None)
+    def predict(self, x):
+        return x @ self.w
+
+w = rng.normal(size=(F, O)).astype(np.float32)
+fleet = serving.ServingFleet(
+    replicas=3, stall_timeout_s=0.3, cooldown_s=0.3, error_threshold=2,
+    max_retries=8, retry_budget=512.0,
+    admission_kwargs={"max_queue_rows": 16, "retry_after_s": 0.01},
+)
+fleet.register("lin", models=[Linear(w) for _ in fleet.replicas],
+               feature_dim=F, min_bucket=8, max_batch=32,
+               max_delay_s=0.005, warm=True)
+
+# one replica stalls mid-step for a full second while mixed-priority
+# traffic keeps arriving against a deliberately squeezed queue: the
+# breaker must eject it, every in-flight victim must fail over, every
+# high/normal request must be SERVED, and only `low` may be shed
+# terminally (its class rides half the queue bound) — never lost
+inj = fault.FaultInjector().stall_in("serving.step.r0", 1.0, times=1)
+classes = ("high", "normal", "low")
+with fault.injected(inj):
+    futures = []
+    for i in range(48):
+        x = np.ones((1 + i % 4, F), dtype=np.float32)
+        futures.append((i, classes[i % 3], fleet.submit(
+            "lin", x, key=i, priority=classes[i % 3])))
+    served, shed_terminal = 0, 0
+    for i, cls, f in futures:
+        try:
+            out = np.asarray(f.result(60))
+        except RequestRejected as exc:
+            assert cls == "low", f"{cls} request {i} shed: {exc}"
+            assert exc.reason == "queue_full", exc.reason
+            shed_terminal += 1
+        else:
+            assert out.shape == (1 + i % 4, O), (i, out.shape)
+            served += 1
+assert inj.fired == [("stall", "serving.step.r0")], inj.fired
+assert served + shed_terminal == 48
+
+stats = fleet.stats()
+assert stats["ejections"] >= 1, stats
+assert stats["failovers"] >= 1, stats
+assert stats["lost_futures"] == 0, stats
+# the stalled replica re-enters through a half-open probation probe
+deadline = time.monotonic() + 15
+while time.monotonic() < deadline:
+    if all(r.state == HEALTHY for r in fleet.replicas):
+        break
+    time.sleep(0.05)
+else:
+    raise AssertionError(f"r0 never recovered: {fleet.stats()}")
+stats = fleet.stats()
+assert stats["probes"] >= 1 and stats["recoveries"] >= 1, stats
+
+# the per-class accept/shed ledger: every class took traffic, and the
+# squeezed queue shed `low` first (a shed is an admission event — most
+# were retried into service by the router's backoff, never lost)
+rep = telemetry.serving_report()
+for cls in classes:
+    assert rep["accepted_by_class"][cls] > 0, rep["accepted_by_class"]
+shed_ledger = dict(rep["shed_by_class"])
+assert shed_ledger["low"] >= 1, shed_ledger
+assert shed_ledger["low"] >= max(shed_ledger["high"], shed_ledger["normal"]), \
+    f"low must shed first: {shed_ledger}"
+
+# every router gauge must land in the Prometheus exposition and parse
+prom = telemetry.export_prometheus()
+router_gauges = {}
+for line in prom.splitlines():
+    if line.startswith("heat_tpu_router_"):
+        name, value = line.rsplit(None, 1)
+        router_gauges[name] = float(value)
+for want in ("dispatched", "failovers", "ejections", "lost_futures",
+             "probes", "recoveries"):
+    assert f"heat_tpu_router_{want}" in router_gauges, sorted(router_gauges)
+assert router_gauges["heat_tpu_router_lost_futures"] == 0.0
+fleet.close()
+print(f"fault drill OK: served={served} shed_low={shed_terminal} "
+      f"ejections={stats['ejections']} failovers={stats['failovers']} "
+      f"probes={stats['probes']} shed_ledger={shed_ledger} lost=0")
 EOF
 
 say "CI GREEN"

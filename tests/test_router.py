@@ -1,0 +1,443 @@
+"""Fleet router (ISSUE 18): consistent-hash placement, the per-replica
+circuit breaker, bounded retry/failover, SLO shed ordering, and
+zero-downtime rolling weight swaps.
+
+The failure matrix runs against REAL injected faults riding the guard
+hooks (``serving.step.<replica>`` fires inside the replica's worker,
+``serving.replica.<name>`` inside the router's dispatch) — no mocks.
+The laws:
+
+* a replica stall or error burst never loses a caller's future —
+  failover re-dispatches, the caller sees added latency at worst;
+* an ejected replica re-enters only through a half-open probation
+  probe (one real request through the full stack);
+* ``rolling_swap`` under concurrent traffic is new operands, not a
+  retrace (zero step compiles / fusion misses / ring builds), and a
+  regressing canary auto-rolls back with the old weights still serving.
+
+``scripts/ci.sh`` stage 21 re-runs this file at mesh sizes 1/4/8.
+"""
+
+import time
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.core import telemetry
+from heat_tpu.serving import RequestRejected, ServingFleet
+from heat_tpu.serving.router import HEALTHY
+from heat_tpu.utils import fault
+
+from .base import TestCase
+
+_RNG = np.random.default_rng(1818)
+_F, _O = 8, 4
+
+
+class _Linear:
+    """Swappable model: one resident operand, real mesh matmul."""
+
+    def __init__(self, w):
+        self.w = ht.array(w, split=None)
+
+    def predict(self, x):
+        return x @ self.w
+
+
+def _weights():
+    return _RNG.normal(size=(_F, _O)).astype(np.float32)
+
+
+def _fleet(n=2, **kwargs):
+    telemetry.reset_group("serving")
+    telemetry.reset_group("router")
+    kwargs.setdefault("stall_timeout_s", 0.15)
+    kwargs.setdefault("cooldown_s", 0.2)
+    kwargs.setdefault("error_threshold", 2)
+    kwargs.setdefault("probe_timeout_s", 15.0)
+    return ServingFleet(replicas=n, **kwargs)
+
+
+def _register_linear(fleet, w, name="lin", **kwargs):
+    models = [_Linear(w) for _ in fleet.replicas]
+    kwargs.setdefault("min_bucket", 8)
+    kwargs.setdefault("max_batch", 16)
+    fleet.register(name, models=models, feature_dim=_F, warm=True, **kwargs)
+    return models
+
+
+def _wait_all_healthy(fleet, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(r.state == HEALTHY for r in fleet.replicas):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _key_for(fleet, name):
+    """A request key whose consistent-hash home is replica ``name``."""
+    for key in range(4096):
+        if fleet._ring_order(key)[0].name == name:
+            return key
+    raise AssertionError(f"no key hashes home to {name}")
+
+
+class TestPlacement(TestCase):
+    def test_consistent_hash_affinity(self):
+        fleet = _fleet(n=4)
+        try:
+            # same key -> same home replica, every time; keys spread
+            # across the fleet rather than piling on one replica
+            homes = {key: fleet._ring_order(key)[0].name for key in range(64)}
+            for key, home in homes.items():
+                for _ in range(3):
+                    self.assertEqual(fleet._ring_order(key)[0].name, home)
+            self.assertGreaterEqual(len(set(homes.values())), 2)
+        finally:
+            fleet.close()
+
+    def test_routes_around_ejected_replica(self):
+        fleet = _fleet(n=2)
+        try:
+            _register_linear(fleet, _weights())
+            victim = fleet._ring_order("pinned")[0]
+            with fleet._lock:
+                fleet._eject_locked(victim, "test")
+            # the home is benched, but the request still serves — routed
+            # to the surviving sibling without a retry
+            x = np.ones((2, _F), dtype=np.float32)
+            out = fleet.predict("lin", x, key="pinned")
+            self.assertEqual(np.asarray(out).shape[0], 2)
+        finally:
+            fleet.close()
+
+
+class TestFailoverMatrix(TestCase):
+    """The ISSUE 18 acceptance drills, one injected fault per test."""
+
+    def test_replica_stall_fails_over_with_zero_lost_futures(self):
+        fleet = _fleet(n=2)
+        try:
+            _register_linear(fleet, _weights())
+            x = np.ones((2, _F), dtype=np.float32)
+            inj = fault.FaultInjector().stall_in("serving.step.r0", 1.0, times=1)
+            with fault.injected(inj):
+                futures = [
+                    fleet.submit("lin", x, key=f"k{i}") for i in range(12)
+                ]
+                results = [f.result(30) for f in futures]
+            self.assertEqual(len(results), 12)
+            for r in results:
+                self.assertEqual(np.asarray(r).shape, (2, _O))
+            self.assertEqual(inj.fired, [("stall", "serving.step.r0")])
+            stats = fleet.stats()
+            self.assertGreaterEqual(stats["ejections"], 1)
+            self.assertGreaterEqual(stats["failovers"], 1)
+            self.assertEqual(stats["lost_futures"], 0)
+            # the circuit reopens via a half-open probe, not a timer alone
+            self.assertTrue(_wait_all_healthy(fleet), "r0 never recovered")
+            stats = fleet.stats()
+            self.assertGreaterEqual(stats["half_opens"], 1)
+            self.assertGreaterEqual(stats["probes"], 1)
+            self.assertGreaterEqual(stats["recoveries"], 1)
+        finally:
+            fleet.close()
+
+    def test_error_burst_opens_circuit_then_probe_recovers(self):
+        fleet = _fleet(n=2)
+        try:
+            _register_linear(fleet, _weights())
+            x = np.ones((1, _F), dtype=np.float32)
+            pinned = _key_for(fleet, "r1")
+            inj = fault.FaultInjector().error_in("serving.step.r1", times=5)
+            with fault.injected(inj):
+                # sequential pinned traffic: each batch on r1 fails for
+                # real, fails over to r0, and the consecutive-failure
+                # counter marches the circuit open
+                for _ in range(4):
+                    out = fleet.predict("lin", x, key=pinned)
+                    self.assertEqual(np.asarray(out).shape, (1, _O))
+                stats = fleet.stats()
+                self.assertGreaterEqual(stats["ejections"], 1)
+                # remaining armed faults fail the first probation probes
+                # (probe_failures re-eject); once the arms run dry a
+                # probe succeeds and the circuit closes for real
+                self.assertTrue(
+                    _wait_all_healthy(fleet),
+                    "circuit never reopened after the error burst",
+                )
+            stats = fleet.stats()
+            self.assertGreaterEqual(stats["failovers"], 1)
+            self.assertGreaterEqual(stats["probes"], 1)
+            self.assertGreaterEqual(stats["recoveries"], 1)
+            self.assertEqual(stats["lost_futures"], 0)
+        finally:
+            fleet.close()
+
+    def test_dispatch_fault_at_replica_site_fails_over(self):
+        fleet = _fleet(n=2)
+        try:
+            _register_linear(fleet, _weights())
+            x = np.ones((1, _F), dtype=np.float32)
+            home = fleet._ring_order("pin")[0].name
+            inj = fault.FaultInjector().error_in(f"serving.replica.{home}", times=1)
+            with fault.injected(inj):
+                out = fleet.predict("lin", x, key="pin")
+            self.assertEqual(np.asarray(out).shape, (1, _O))
+            self.assertEqual(inj.fired, [("error", f"serving.replica.{home}")])
+            self.assertGreaterEqual(fleet.stats()["failovers"], 1)
+        finally:
+            fleet.close()
+
+    def test_queue_full_backs_off_and_retries_same_replica(self):
+        # one replica, tiny queue: the only way out is jittered backoff
+        # against the retry budget, then the drained queue admits
+        fleet = _fleet(
+            n=1,
+            admission_kwargs={"max_queue_rows": 8, "retry_after_s": 0.01},
+            max_retries=4,
+            retry_budget=64.0,
+        )
+        try:
+            _register_linear(fleet, _weights(), max_delay_s=0.01)
+            x = np.ones((4, _F), dtype=np.float32)
+            futures = [fleet.submit("lin", x, key=i) for i in range(8)]
+            results = [f.result(30) for f in futures]
+            self.assertEqual(len(results), 8)
+            stats = fleet.stats()
+            self.assertGreaterEqual(stats["backoffs"], 1)
+            self.assertEqual(stats["lost_futures"], 0)
+        finally:
+            fleet.close()
+
+    def test_all_replicas_ejected_is_documented_unavailable(self):
+        fleet = _fleet(n=2, max_retries=0)
+        try:
+            _register_linear(fleet, _weights())
+            with fleet._lock:
+                for replica in fleet.replicas:
+                    fleet._eject_locked(replica, "test")
+            with self.assertRaisesRegex(RequestRejected, "unavailable"):
+                fleet.submit(
+                    "lin", np.ones((1, _F), dtype=np.float32)
+                ).result(10)
+            self.assertTrue(_wait_all_healthy(fleet))  # probes bring them back
+        finally:
+            fleet.close()
+
+
+class TestSLOFleet(TestCase):
+    def test_low_priority_sheds_first_under_pressure(self):
+        fleet = _fleet(
+            n=1,
+            max_retries=0,
+            admission_kwargs={"max_queue_rows": 8},
+        )
+        _register_linear(fleet, _weights(), max_delay_s=30.0)  # hold queue
+        x3 = np.ones((3, _F), dtype=np.float32)
+        x2 = np.ones((2, _F), dtype=np.float32)
+        held = fleet.submit("lin", x3, priority="high")
+        # 3 rows queued: low's bound is int(8 * 0.5) = 4, so a 2-row low
+        # request overflows its class first while high still admits
+        low = fleet.submit("lin", x2, priority="low")
+        with self.assertRaisesRegex(RequestRejected, "queue_full"):
+            low.result(10)
+        accepted_high = fleet.submit("lin", x2, priority="high")
+        serving_stats = telemetry.serving_report()
+        self.assertGreaterEqual(serving_stats["shed_by_class"]["low"], 1)
+        self.assertGreaterEqual(serving_stats["accepted_by_class"]["high"], 2)
+        # closing drains the held queue — nothing accepted is lost
+        fleet.close()
+        self.assertEqual(np.asarray(held.result(10)).shape, (3, _O))
+        self.assertEqual(np.asarray(accepted_high.result(10)).shape, (2, _O))
+        self.assertEqual(fleet.stats()["lost_futures"], 0)
+
+    def test_lapsed_deadline_resolves_expired_not_lost(self):
+        fleet = _fleet(n=1, max_retries=0)
+        try:
+            _register_linear(fleet, _weights(), max_delay_s=0.25)
+            x = np.ones((2, _F), dtype=np.float32)
+            # the client deadline lapses before the 0.25 s flush fires;
+            # the batcher drops the request as `expired` — a terminal
+            # reject the router never retries
+            doomed = fleet.submit("lin", x, deadline_s=0.05, key="d")
+            with self.assertRaisesRegex(RequestRejected, "expired"):
+                doomed.result(10)
+            self.assertGreaterEqual(
+                telemetry.serving_report()["shed"]["expired"], 1
+            )
+            # the lane stays live: a fresh request with headroom serves
+            out = fleet.predict("lin", x, key="ok")
+            self.assertEqual(np.asarray(out).shape, (2, _O))
+        finally:
+            fleet.close()
+
+
+class TestRollingSwap(TestCase):
+    def test_rolling_swap_under_traffic_no_retrace(self):
+        fleet = _fleet(n=2)
+        try:
+            w_old, w_new = _weights(), _weights()
+            _register_linear(fleet, w_old)
+            x = _RNG.normal(size=(2, _F)).astype(np.float32)
+            for i in range(8):  # warm reservoirs on both replicas
+                fleet.predict("lin", x, key=f"w{i}")
+            steps_before = telemetry.serving_report()["step_compiles"]
+            fusion_before = telemetry.snapshot_group("fusion").get("misses", 0)
+            ring_before = telemetry.snapshot_group("overlap").get("ring_builds", 0)
+
+            futures = [
+                fleet.submit("lin", x, key=f"t{i}") for i in range(8)
+            ]
+            report = fleet.rolling_swap(
+                "lin", {"w": ht.array(w_new, split=None)}, canary=1
+            )
+            for f in futures:
+                self.assertEqual(np.asarray(f.result(30)).shape, (2, _O))
+
+            self.assertFalse(report["rolled_back"])
+            self.assertEqual(
+                sorted(report["swapped"]), sorted(r.name for r in fleet.replicas)
+            )
+            got = np.asarray(fleet.predict("lin", x, key="post"))
+            np.testing.assert_allclose(got, x @ w_new, rtol=1e-4, atol=1e-4)
+            self.assertEqual(
+                telemetry.serving_report()["step_compiles"], steps_before,
+                "a rolling swap is new operands, not a retrace",
+            )
+            self.assertEqual(
+                telemetry.snapshot_group("fusion").get("misses", 0), fusion_before
+            )
+            self.assertEqual(
+                telemetry.snapshot_group("overlap").get("ring_builds", 0),
+                ring_before,
+            )
+        finally:
+            fleet.close()
+
+    def test_canary_regression_rolls_back_old_weights_still_serving(self):
+        fleet = _fleet(n=2)
+        try:
+            w_old, w_new = _weights(), _weights()
+            _register_linear(fleet, w_old)
+            x = _RNG.normal(size=(2, _F)).astype(np.float32)
+            for i in range(8):  # baselines come from the warm reservoirs
+                fleet.predict("lin", x, key=f"w{i}")
+            canary = fleet.replicas[0].name
+            # every post-swap canary probe fails through the real step
+            # path; concurrent traffic rides failover meanwhile
+            inj = fault.FaultInjector().error_in(
+                f"serving.step.{canary}", times=64
+            )
+            with fault.injected(inj):
+                futures = [
+                    fleet.submit("lin", x, key=f"t{i}") for i in range(8)
+                ]
+                report = fleet.rolling_swap(
+                    "lin", {"w": ht.array(w_new, split=None)}, canary=1
+                )
+                for f in futures:
+                    self.assertEqual(np.asarray(f.result(30)).shape, (2, _O))
+            self.assertTrue(report["rolled_back"])
+            self.assertIn(canary, report["reason"])
+            self.assertEqual(report["swapped"], [])
+            self.assertGreaterEqual(fleet.stats()["rollbacks"], 1)
+            # both replicas serve the OLD weights again
+            for key in ("post0", "post1", "post2", "post3"):
+                got = np.asarray(fleet.predict("lin", x, key=key))
+                np.testing.assert_allclose(got, x @ w_old, rtol=1e-4, atol=1e-4)
+        finally:
+            fleet.close()
+
+    def test_shared_model_refuses_canary_swap(self):
+        fleet = _fleet(n=2)
+        try:
+            shared = _Linear(_weights())
+            fleet.register(
+                "sh", shared, feature_dim=_F, min_bucket=8, max_batch=16
+            )
+            with self.assertRaisesRegex(ValueError, "models="):
+                fleet.rolling_swap("sh", {"w": shared.w})
+        finally:
+            fleet.close()
+
+
+class TestRouterTelemetry(TestCase):
+    def test_router_gauges_reach_prometheus_and_report(self):
+        fleet = _fleet(n=2)
+        try:
+            _register_linear(fleet, _weights())
+            x = np.ones((1, _F), dtype=np.float32)
+            for i in range(4):
+                fleet.predict("lin", x, key=i)
+            prom = telemetry.export_prometheus()
+            self.assertIn("heat_tpu_router_dispatched", prom)
+            self.assertIn("heat_tpu_router_failovers", prom)
+            self.assertIn("heat_tpu_router_ejections", prom)
+            report = telemetry.router_report()
+            self.assertGreaterEqual(report["dispatched"], 4)
+            self.assertEqual(report["lost_futures"], 0)
+        finally:
+            fleet.close()
+
+    def test_health_transitions_reach_flight_recorder(self):
+        with telemetry.telemetry_level("events"):
+            telemetry.clear_events()
+            fleet = _fleet(n=2)
+            try:
+                _register_linear(fleet, _weights())
+                x = np.ones((1, _F), dtype=np.float32)
+                pinned = _key_for(fleet, "r0")
+                inj = fault.FaultInjector().error_in("serving.step.r0", times=3)
+                with fault.injected(inj):
+                    for _ in range(4):
+                        fleet.predict("lin", x, key=pinned)
+                    self.assertTrue(_wait_all_healthy(fleet))
+                kinds = {e["kind"] for e in telemetry.events()}
+                self.assertIn("router_health", kinds)
+                self.assertIn("router_probe", kinds)
+            finally:
+                fleet.close()
+
+
+class TestFleetLifecycle(TestCase):
+    def test_close_drains_and_rejects_new_work(self):
+        fleet = _fleet(n=2)
+        _register_linear(fleet, _weights(), max_delay_s=30.0)
+        x = np.ones((2, _F), dtype=np.float32)
+        futures = [fleet.submit("lin", x, key=i) for i in range(4)]
+        fleet.close()
+        for f in futures:
+            self.assertEqual(np.asarray(f.result(10)).shape, (2, _O))
+        with self.assertRaisesRegex(RequestRejected, "closed"):
+            fleet.submit("lin", x)
+        fleet.close()  # idempotent
+
+    def test_context_manager(self):
+        with _fleet(n=1) as fleet:
+            _register_linear(fleet, _weights())
+            out = fleet.predict("lin", np.ones((1, _F), dtype=np.float32))
+            self.assertEqual(np.asarray(out).shape, (1, _O))
+
+    def test_constructor_validation(self):
+        with self.assertRaises(ValueError):
+            ServingFleet(replicas=0)
+        with self.assertRaises(ValueError):
+            ServingFleet(replicas=2, error_threshold=0)
+        fleet = _fleet(n=2)
+        try:
+            with self.assertRaisesRegex(ValueError, "one model per replica"):
+                fleet.register(
+                    "bad", models=[_Linear(_weights())], feature_dim=_F
+                )
+            with self.assertRaises(KeyError):
+                fleet.submit("nope", np.ones((1, _F), dtype=np.float32))
+        finally:
+            fleet.close()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -208,6 +208,30 @@ class TestAdmissionUnit(unittest.TestCase):
         with self.assertRaisesRegex(RequestRejected, "closed"):
             adm.admit("e", 1, 0)
 
+    def test_low_class_sheds_first_under_queue_pressure(self):
+        # low rides 0.5 of the bound by default: at 3/8 queued rows a
+        # 2-row low request overflows its bound (4) while normal/high
+        # still admit against the full 8
+        adm = AdmissionController(max_queue_rows=8)
+        adm.admit("e", 3, 0, priority="normal")
+        with self.assertRaisesRegex(RequestRejected, "queue_full") as ctx:
+            adm.admit("e", 2, 0, priority="low")
+        self.assertIn("'low'", str(ctx.exception))
+        adm.admit("e", 2, 0, priority="high")
+        adm.admit("e", 2, 0)  # normal keeps the full bound
+
+    def test_class_threshold_validation(self):
+        with self.assertRaisesRegex(ValueError, r"\(0, 1\]"):
+            AdmissionController(class_thresholds={"low": 0.0})
+        adm = AdmissionController(
+            max_queue_rows=10, class_thresholds={"batch": 0.2}
+        )
+        adm.admit("e", 2, 0, priority="batch")
+        with self.assertRaisesRegex(RequestRejected, "queue_full"):
+            adm.admit("e", 1, 0, priority="batch")
+        with self.assertRaisesRegex(ValueError, "unknown SLO class"):
+            adm.admit("e", 1, 0, priority="platinum")
+
     def test_stall_latch_via_subscription_and_recovery(self):
         det = fault.StallDetector(timeout=60.0)  # never fires on its own
         adm = AdmissionController().attach_stall_detector(det)
@@ -417,6 +441,178 @@ class TestStallShedding(TestCase):
             self.assertEqual(np.asarray(out).shape[0], 1)
         finally:
             det.stop()
+            eng.close()
+
+
+class TestSLOAndDeadlines(TestCase):
+    """ISSUE 18: per-request SLO classes and client deadlines on the
+    single-engine path — low sheds first, lapsed deadlines are dropped
+    at flush (``expired``) instead of computing dead work."""
+
+    def test_engine_counts_accepted_and_shed_per_class(self):
+        eng = _engine(admission=AdmissionController(max_queue_rows=8))
+        try:
+            eng.register(
+                "id", predict=lambda x: x, feature_dim=4, max_batch=8,
+                max_delay_s=30.0, warm=True,  # hold the queue open
+            )
+            eng.submit("id", np.ones((3, 4), dtype=np.float32), priority="high")
+            with self.assertRaisesRegex(RequestRejected, "queue_full"):
+                eng.submit("id", np.ones((2, 4), dtype=np.float32), priority="low")
+            stats = eng.stats()
+            self.assertEqual(stats["accepted_by_class"]["high"], 1)
+            self.assertEqual(stats["shed_by_class"]["low"], 1)
+        finally:
+            eng.close()
+
+    def test_lapsed_client_deadline_dropped_at_flush_as_expired(self):
+        eng = _engine()
+        try:
+            eng.register(
+                "id", predict=lambda x: x, feature_dim=4, min_bucket=8,
+                max_batch=8, max_delay_s=0.25, warm=True,
+            )
+            # deadline (0.05s) lapses before the flush timer (0.25s):
+            # the request must resolve `expired`, not compute
+            doomed = eng.submit(
+                "id", np.ones((1, 4), dtype=np.float32),
+                priority="low", deadline_s=0.05,
+            )
+            with self.assertRaisesRegex(
+                RequestRejected, r"serving request rejected \(expired\)"
+            ) as ctx:
+                doomed.result(10)
+            self.assertEqual(ctx.exception.reason, "expired")
+            stats = eng.stats()
+            self.assertGreaterEqual(stats["shed"]["expired"], 1)
+            self.assertGreaterEqual(stats["shed_by_class"]["low"], 1)
+            # the expired rows freed queue budget: the engine still serves
+            out = eng.predict("id", np.ones((2, 4), dtype=np.float32))
+            self.assertEqual(np.asarray(out).shape[0], 2)
+        finally:
+            eng.close()
+
+    def test_deadline_validation(self):
+        eng = _engine()
+        try:
+            eng.register("id", predict=lambda x: x, feature_dim=4, max_batch=8)
+            with self.assertRaisesRegex(ValueError, "deadline_s"):
+                eng.submit(
+                    "id", np.ones((1, 4), dtype=np.float32), deadline_s=0.0
+                )
+        finally:
+            eng.close()
+
+
+class TestErrorPathLiveness(TestCase):
+    """Satellite of ISSUE 18: a failing step is liveness, not a stall.
+    Before the fix, `_execute`'s exception path never beat the detector,
+    so a burst of consecutive injected step errors latched `stalled` and
+    shed all traffic from a live worker."""
+
+    def test_error_burst_never_latches_stall(self):
+        eng = _engine(admission=AdmissionController(retry_after_s=0.02))
+        det = fault.StallDetector(timeout=0.12)
+        eng.attach_stall_detector(det)
+        det.start()
+        try:
+            eng.register(
+                "id", predict=lambda x: x, feature_dim=4, min_bucket=8,
+                max_batch=8, max_delay_s=0.001, warm=True,
+            )
+            det.beat()
+            # every batch for ~4x the stall timeout fails via a real
+            # injected fault at the serving.step site
+            inj = fault.FaultInjector().error_in("serving.step", times=64)
+            with fault.injected(inj):
+                deadline = time.monotonic() + 0.5
+                while time.monotonic() < deadline:
+                    fut = eng.submit("id", np.ones((1, 4), dtype=np.float32))
+                    with self.assertRaisesRegex(
+                        fault.FaultInjector.InjectedFault, "injected failure"
+                    ):
+                        fut.result(10)
+                    self.assertFalse(
+                        eng.admission.stalled,
+                        "error burst latched `stalled` on a live worker",
+                    )
+                    time.sleep(0.03)
+            self.assertEqual(eng.stats()["shed"]["stalled"], 0)
+            self.assertGreaterEqual(eng.stats()["step_errors"], 3)
+            # the worker was never wedged: the next clean batch serves
+            out = eng.predict("id", np.ones((2, 4), dtype=np.float32))
+            self.assertEqual(np.asarray(out).shape[0], 2)
+        finally:
+            det.stop()
+            eng.close()
+
+
+class TestWeightSwap(TestCase):
+    """ISSUE 18: `swap_weights` exchanges operands under traffic with
+    zero step compiles — and refuses shape/dtype/split changes (those
+    are retraces, not swaps)."""
+
+    class _Linear:
+        def __init__(self, w):
+            self.w = ht.array(w, split=None)
+
+        def predict(self, x):
+            return x @ self.w
+
+    def test_swap_serves_new_weights_with_zero_step_compiles(self):
+        w_old = _RNG.normal(size=(8, 4)).astype(np.float32)
+        w_new = _RNG.normal(size=(8, 4)).astype(np.float32)
+        model = self._Linear(w_old)
+        eng = _engine()
+        try:
+            eng.register(
+                "lin", model, feature_dim=8, min_bucket=8, max_batch=8, warm=True
+            )
+            x = _RNG.normal(size=(2, 8)).astype(np.float32)
+            np.testing.assert_allclose(
+                np.asarray(eng.predict("lin", x)), x @ w_old, rtol=1e-4, atol=1e-4
+            )
+            steps_before = eng.stats()["step_compiles"]
+            fusion_before = telemetry.snapshot_group("fusion").get("misses", 0)
+            old = eng.swap_weights("lin", {"w": ht.array(w_new, split=None)})
+            np.testing.assert_allclose(
+                np.asarray(eng.predict("lin", x)), x @ w_new, rtol=1e-4, atol=1e-4
+            )
+            self.assertEqual(
+                eng.stats()["step_compiles"], steps_before,
+                "a weight swap is new operands, not a retrace",
+            )
+            self.assertEqual(
+                telemetry.snapshot_group("fusion").get("misses", 0), fusion_before
+            )
+            self.assertGreaterEqual(eng.stats()["swaps"], 1)
+            # the returned old operands roll back
+            eng.swap_weights("lin", old)
+            np.testing.assert_allclose(
+                np.asarray(eng.predict("lin", x)), x @ w_old, rtol=1e-4, atol=1e-4
+            )
+        finally:
+            eng.close()
+
+    def test_swap_refuses_retrace_shapes_and_bare_predict(self):
+        model = self._Linear(_RNG.normal(size=(8, 4)).astype(np.float32))
+        eng = _engine()
+        try:
+            eng.register("lin", model, feature_dim=8, max_batch=8)
+            eng.register("bare", predict=lambda x: x, feature_dim=8, max_batch=8)
+            with self.assertRaisesRegex(ValueError, "shape.*retrace"):
+                eng.swap_weights(
+                    "lin", {"w": ht.array(np.zeros((8, 5), dtype=np.float32))}
+                )
+            with self.assertRaisesRegex(ValueError, "dtype"):
+                eng.swap_weights(
+                    "lin", {"w": ht.array(np.zeros((8, 4), dtype=np.int32))}
+                )
+            with self.assertRaisesRegex(ValueError, "no operand"):
+                eng.swap_weights("lin", {"nope": np.zeros((8, 4))})
+            with self.assertRaisesRegex(ValueError, "model="):
+                eng.swap_weights("bare", {"w": np.zeros((8, 4))})
+        finally:
             eng.close()
 
 
