@@ -210,3 +210,15 @@ WIRE_MM_M, WIRE_MM_K, WIRE_MM_N = (
 )
 QKNN_N, QKNN_F = (65_536, 64) if ON_TPU else (2_048, 32)
 QKNN_REQS = 128 if ON_TPU else 48
+# sparse compute tier rows (round 21): the tuned SpMV through its
+# autotune-dispatched surfaces.  spmv_csr sized so the DCSR slabs are a
+# real residency win over the 4*n^2-byte dense affinity (<=2% density
+# puts the exact-ledger ratio far past the 3x acceptance bar) while the
+# CPU cold explore (all three arms in the timed region) stays in
+# seconds; the knn rows keep density under the 5% bar the ledger gate
+# asserts (k=6 symmetrized: nnz <~ 2*k*n)
+SPMV_N, SPMV_DENSITY = (131_072, 0.002) if ON_TPU else (4_096, 0.02)
+SPMV_RHS_K = 4
+KNNG_N, KNNG_F, KNNG_K = (65_536, 16, 6) if ON_TPU else (512, 8, 6)
+KNNG_LANCZOS = 32 if ON_TPU else 16
+KNNG_REQS = 128 if ON_TPU else 36

@@ -95,6 +95,20 @@ TOLERANCE = {
     # lost_futures=0 and the measured recovery tail, both asserted
     # inside the workload itself
     "router_failover": 0.5,
+    # round-19 sparse-tier rows (sparse.py's own notes): spmv_csr is
+    # measured from a COLD tuning table — the timed region includes the
+    # explore phase running all three arms, one of which (dense) does a
+    # full todense+matmul per call, so the wall rides how quickly the
+    # table resolved; the headline the row vouches for is the
+    # exact-ledger residency columns, which the ci.sh stage-22 gate
+    # checks separately
+    "spmv_csr": 0.5,
+    # single-run whole-`.fit` wall like the kmeans rows (the estimator's
+    # host readbacks ride the number), plus a cold knn top-k compile
+    "spectral_sparse": 0.5,
+    # single-run batched wall over a thread pool, same contract as
+    # serving_batch: Python thread scheduling rides the number
+    "serving_knn_graph": 0.5,
 }
 
 _ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")

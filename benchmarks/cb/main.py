@@ -20,6 +20,7 @@ import quantize
 import regression
 import router
 import serving
+import sparse
 import wire
 
 from heat_tpu.core import telemetry as _telemetry
@@ -94,7 +95,7 @@ if __name__ == "__main__":
         default=None,
         help="comma-separated subset: "
              "linalg,cluster,manipulations,nn,regression,fusion,kernels,"
-             "serving,router,quantize,wire",
+             "serving,router,quantize,wire,sparse",
     )
     ap.add_argument(
         "--check-regression",
@@ -117,6 +118,7 @@ if __name__ == "__main__":
         "regression": regression.run,
         "router": router.run,
         "serving": serving.run,
+        "sparse": sparse.run,
         "wire": wire.run,
     }
     selected = (

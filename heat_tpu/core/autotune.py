@@ -78,6 +78,8 @@ __all__ = [
     "salt",
     "save",
     "set_enabled",
+    "SPMV_ARMS",
+    "spmv_key",
     "stats",
     "table",
     "WIRE_ARMS",
@@ -105,11 +107,21 @@ QUANT_ARMS = ("bf16", "int8")
 # GEMM *computes on*, these pick what the COLLECTIVE *ships* — a site can
 # hold both kinds of entries at once.
 WIRE_ARMS = ("wire_f32", "wire_int8", "wire_fp8")
+# round 19: the sparse compute tier (sparse/matmul.py) — "dense" is the
+# todense() matmul (the authoritative reference; explore always returns
+# its result so numerics never depend on tuning state), "gather" the
+# jitted segment-sum CSR matvec that runs on every backend, "kernel" the
+# lane-aware Pallas ELL SpMV with safe decline (non-TPU, non-f32,
+# VMEM-exceeding row blocks).  A triple, not a pair: the measured winner
+# on a given sparsity geometry is genuinely any of the three (dense wins
+# near-full matrices, gather wins tiny ones, the kernel wins the
+# lane-friendly middle).
+SPMV_ARMS = ("dense", "gather", "kernel")
 # every arm name any entry may carry; load() refuses winners outside it
 # so a corrupt cache cannot inject an undispatched arm
 _KNOWN_ARMS = (
     frozenset(ARMS) | frozenset(KERNEL_ARMS) | frozenset(QUANT_ARMS)
-    | frozenset(WIRE_ARMS)
+    | frozenset(WIRE_ARMS) | frozenset(SPMV_ARMS)
 )
 CACHE_VERSION = 1
 
@@ -356,6 +368,18 @@ def quant_key(site: str, *geometry) -> Tuple[str, str]:
     vs "int8" (the low-precision buffer rides the GEMM, per-channel
     scales fold into the ring epilogue as runtime extras)."""
     fp = telemetry.fingerprint(("quant", site) + tuple(geometry))
+    return fp, device_kind()
+
+
+def spmv_key(site: str, *geometry) -> Tuple[str, str]:
+    """Tuning-table key for one sparse-matmul dispatch site
+    (``spmv_csr`` — sparse/matmul.py) at one sparsity geometry
+    (shape, nnz bucket, slab capacity, ELL width, rhs columns, dtype,
+    mesh size).  The entry's arms are :data:`SPMV_ARMS`: "dense"
+    (todense() + the ordinary matmul — the reference arm explore
+    returns), "gather" (jitted segment-sum CSR matvec, every backend),
+    "kernel" (the Pallas ELL SpMV, safe decline off-TPU/non-f32)."""
+    fp = telemetry.fingerprint(("spmv", site) + tuple(geometry))
     return fp, device_kind()
 
 

@@ -72,17 +72,29 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     return x_ht
 
 
-@functools.partial(jax.jit, static_argnames=("m",))
-def _lanczos_loop(arr, v, m: int):
+def _dense_apply(operands, v):
+    """The dense operator: ``operands`` is the 1-tuple ``(A,)``."""
+    return operands[0] @ v
+
+
+@functools.partial(jax.jit, static_argnames=("m", "apply_fn"))
+def _lanczos_loop_op(operands, v, m: int, apply_fn):
     """Three-term Lanczos recurrence with full reorthogonalization, fused
     into one XLA program.  The basis lives as a row-stacked (m, n) array so
     reorthogonalization is two matvecs against the filled prefix (masked by
-    iteration index) instead of a Python loop over saved vectors."""
-    n = arr.shape[0]
-    dtype = arr.dtype
+    iteration index) instead of a Python loop over saved vectors.
+
+    The operator is abstract: ``apply_fn(operands, v)`` computes ``A @ v``
+    — the dense path passes ``(A,)`` with :func:`_dense_apply` (bit-for-bit
+    the pre-refactor program), the sparse path passes the CSR/ELL slabs
+    with the arm `sparse.matmul.matvec_program` consulted from the tuning
+    table.  ``apply_fn`` must be a stable hashable (the lru-cached program
+    factories guarantee it) since it keys this jit."""
+    n = v.shape[0]
+    dtype = v.dtype
     rows = jnp.arange(m)
 
-    w0 = arr @ v
+    w0 = apply_fn(operands, v)
     a0 = jnp.dot(w0, v)
     state = (
         jnp.zeros((m, n), dtype).at[0].set(v),  # basis V (rows)
@@ -103,7 +115,7 @@ def _lanczos_loop(arr, v, m: int):
         prefix = (rows < i)[:, None].astype(dtype)
         cand = cand - (V * prefix).T @ (V @ cand * (rows < i))
         v_next = cand / jnp.maximum(jnp.linalg.norm(cand), 1e-30)
-        w_new = arr @ v_next
+        w_new = apply_fn(operands, v_next)
         alpha = jnp.dot(w_new, v_next)
         w_new = w_new - alpha * v_next - jnp.where(breakdown, 0.0, beta) * V[i - 1]
         return (
@@ -117,8 +129,13 @@ def _lanczos_loop(arr, v, m: int):
     return V.T, alphas, betas[: m - 1]
 
 
+def _lanczos_loop(arr, v, m: int):
+    """Dense-operand compatibility wrapper over :func:`_lanczos_loop_op`."""
+    return _lanczos_loop_op((arr,), v, m, _dense_apply)
+
+
 def lanczos(
-    A: DNDarray,
+    A,
     m: int,
     v0: Optional[DNDarray] = None,
     V_out: Optional[DNDarray] = None,
@@ -126,27 +143,48 @@ def lanczos(
 ) -> Tuple[DNDarray, DNDarray]:
     """Lanczos tridiagonalization: A ≈ V T V^T with V (n×m) orthonormal and T
     (m×m) tridiagonal (reference: solver.py:69). Basis of spectral clustering.
+
+    ``A`` is a dense DNDarray or a ``sparse.DCSR_matrix`` — the sparse
+    operand runs the whole recurrence over the tuned SpMV program
+    (``sparse.matmul.matvec_program``): gather or Pallas-kernel matvecs
+    inside ONE fused loop, zero densifications.
     """
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
         raise RuntimeError(f"A needs to be a square matrix, got {A.shape}")
     n = A.shape[0]
     m = int(m)
-    arr = A.larray
-    if not jnp.issubdtype(arr.dtype, jnp.inexact):
-        arr = arr.astype(jnp.float32)
+
+    # lazy: core.linalg must not import the sparse package at module load
+    from ...sparse.dcsr_matrix import DCSR_matrix
+
+    sparse_op = isinstance(A, DCSR_matrix)
+    if sparse_op:
+        from ...sparse.matmul import matvec_program
+
+        dtype = A.dtype.jax_type()
+        if not jnp.issubdtype(dtype, jnp.inexact):
+            dtype = jnp.float32
+        apply_fn, operands = matvec_program(A)
+    else:
+        arr = A.larray
+        if not jnp.issubdtype(arr.dtype, jnp.inexact):
+            arr = arr.astype(jnp.float32)
+        dtype = arr.dtype
+        apply_fn, operands = _dense_apply, (arr,)
 
     if v0 is None:
         from .. import random as ht_random
 
-        v = ht_random.rand(n, split=A.split, comm=A.comm, device=A.device).larray.astype(arr.dtype)
+        v = ht_random.rand(n, split=A.split, comm=A.comm, device=A.device).larray.astype(dtype)
         v = v / jnp.linalg.norm(v)
     else:
-        v = v0.larray / jnp.linalg.norm(v0.larray)
+        v = v0.larray.astype(dtype)
+        v = v / jnp.linalg.norm(v)
 
-    Vm, T_alpha, T_beta = _lanczos_loop(arr, v, m)
-    T = jnp.diag(jnp.asarray(T_alpha, dtype=arr.dtype))
+    Vm, T_alpha, T_beta = _lanczos_loop_op(operands, v, m, apply_fn)
+    T = jnp.diag(jnp.asarray(T_alpha, dtype=dtype))
     if m > 1:
-        off = jnp.asarray(T_beta, dtype=arr.dtype)
+        off = jnp.asarray(T_beta, dtype=dtype)
         T = T + jnp.diag(off, 1) + jnp.diag(off, -1)
 
     V_ht = DNDarray(Vm, tuple(Vm.shape), types.canonical_heat_type(Vm.dtype), A.split, A.device, A.comm)

@@ -1,5 +1,5 @@
 """Graph computations (reference: heat/graph/)."""
 
-from .laplacian import Laplacian
+from .laplacian import Laplacian, laplacian_sparse
 
-__all__ = ["Laplacian"]
+__all__ = ["Laplacian", "laplacian_sparse"]

@@ -21,6 +21,7 @@ from .halo import halo_exchange, map_with_halos
 from .matmul import matmul as pallas_matmul
 from .cdist import cdist as fused_cdist
 from .attention import flash_attention
+from .spmv import spmv_ell
 
 __all__ = [
     "halo_exchange",
@@ -28,4 +29,5 @@ __all__ = [
     "pallas_matmul",
     "fused_cdist",
     "flash_attention",
+    "spmv_ell",
 ]

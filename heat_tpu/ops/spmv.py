@@ -1,0 +1,167 @@
+"""Lane-aware Pallas SpMV over ELL-packed CSR row blocks.
+
+The sparse tier's compute problem is the same padded-lane problem
+:mod:`~heat_tpu.ops.repack` solved for narrow minors: a CSR row's
+nonzeros are a ragged run, and the TPU wants (8, 128)-tiled slabs.  The
+repack here is ELL-style — each row block's entries land in a
+``(rows_pad, W)`` slab (``W`` = the max row nnz rounded up to the
+128-lane width, rows padded to the f32 sublane of 8), column ids carry
+``-1`` in the pad slots so the kernel's gather is *lane-masked* rather
+than branchy.  One grid step loads a ``(BR, W)`` tile of values+columns
+plus the full dense operand into VMEM, gathers ``x[cols]`` with the pad
+lanes masked to zero, and writes the ``BR`` row sums — f32 accumulation
+throughout.
+
+Safe-decline contract (the round-15 kernel-tier rule): :func:`spmv_mode`
+returns ``off`` for non-f32 data, for geometries whose tile + operand
+working set exceeds the VMEM budget, off-TPU without forced interpret,
+and under the ``HEAT_TPU_KERNEL_SPMV=off`` kill switch — the dispatcher
+(sparse/matmul.py) then simply never registers the ``kernel`` arm.
+
+Pure compute: for a given ELL slab the result is deterministic (each row
+sums its own ≤W products in lane order); the dispatcher measures it
+against the ``dense`` and ``gather`` arms per sparsity-geometry
+fingerprint, never trusts it blindly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._pallas_common import LANE, kernel_mode, sublane
+
+__all__ = ["ell_pack", "ell_width", "spmv_ell", "spmv_mode", "spmv_supported"]
+
+# VMEM working-set budget per grid step: vals + cols tiles, the full
+# dense operand, and the output rows, comfortably inside the ~16 MiB/core
+# budget with headroom for Pallas' own double-buffering
+_VMEM_BUDGET = 12 << 20
+
+# target value-tile rows per grid step (bounded so the cols+vals tiles
+# stay small even at wide W; always a multiple of the f32 sublane)
+_MAX_BLOCK_ROWS = 512
+
+
+def ell_width(max_row_nnz: int) -> int:
+    """ELL slab width for a row block whose densest row holds
+    ``max_row_nnz`` entries: rounded up to the 128-lane vector width so
+    every gather is a full-lane load (the lane-aware part)."""
+    need = max(1, int(max_row_nnz))
+    return max(LANE, -(-need // LANE) * LANE)
+
+
+def _pad_rows(nrows: int) -> int:
+    sub = sublane(jnp.float32)
+    return max(sub, -(-int(nrows) // sub) * sub)
+
+
+def ell_pack(data, indices, indptr, width: int):
+    """Repack one shard's stripped CSR triple into the ``(rows_pad, W)``
+    ELL slabs (host-side staging, the factory's per-shard slab builder's
+    sparse-compute twin).  ``width`` is the COMMON slab width across the
+    mesh (max row nnz of any shard, lane-rounded) so the stacked
+    ``(S, rows_pad, W)`` arrays shard cleanly.  Pad slots carry value 0
+    and column ``-1`` — the kernel masks on the column sign."""
+    data = np.asarray(data)
+    indices = np.asarray(indices, np.int32)
+    indptr = np.asarray(indptr, np.int64)
+    nrows = len(indptr) - 1
+    counts = np.diff(indptr)
+    if counts.size and int(counts.max()) > width:
+        raise ValueError(
+            f"row with {int(counts.max())} entries exceeds slab width {width}"
+        )
+    rows_pad = _pad_rows(nrows)
+    vals = np.zeros((rows_pad, width), np.float32)
+    cols = np.full((rows_pad, width), -1, np.int32)
+    if data.size:
+        rows_of = np.repeat(np.arange(nrows), counts)
+        slot = np.arange(len(data)) - np.repeat(indptr[:-1], counts)
+        vals[rows_of, slot] = data
+        cols[rows_of, slot] = indices
+    return vals, cols
+
+
+def spmv_supported(nrows: int, ncols: int, width: int, dtype) -> bool:
+    """True iff the kernel handles this shard geometry: f32 values (the
+    MXU-free gather+FMA path accumulates in f32; other dtypes decline to
+    the gather arm) and a working set inside the VMEM budget."""
+    if jnp.dtype(dtype) != jnp.float32:
+        return False
+    if nrows < 1 or ncols < 1 or width < 1:
+        return False
+    w = ell_width(width)
+    npad = -(-int(ncols) // LANE) * LANE
+    br = min(_pad_rows(nrows), _MAX_BLOCK_ROWS)
+    # vals + cols tiles, the replicated dense operand, the output rows
+    working = (2 * br * w + npad + br) * 4
+    return working <= _VMEM_BUDGET
+
+
+def spmv_mode(nrows: int, ncols: int, width: int, dtype) -> str:
+    """Dispatch mode for one SpMV site: ``tpu`` / ``interpret`` when the
+    kernel is live and the geometry is supported, ``off`` otherwise
+    (non-TPU backend without forced interpret, non-f32, VMEM-exceeding
+    row blocks, or ``HEAT_TPU_KERNEL_SPMV=off``)."""
+    if not spmv_supported(nrows, ncols, width, dtype):
+        return "off"
+    return kernel_mode("spmv")
+
+
+def _spmv_kernel(vals_ref, cols_ref, x_ref, o_ref):
+    vals = vals_ref[...]                       # (BR, W) f32
+    cols = cols_ref[...]                       # (BR, W) int32, pads -1
+    x = x_ref[...]                             # (1, Npad) f32
+    live = cols >= 0
+    g = jnp.take(x[0], jnp.where(live, cols, 0).reshape(-1), axis=0)
+    prod = jnp.where(live, vals * g.reshape(vals.shape), 0.0)
+    o_ref[...] = jnp.sum(prod, axis=1, dtype=jnp.float32).reshape(1, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _spmv_call(vals, cols, x, interpret: bool):
+    rows_pad, w = vals.shape
+    npad = -(-x.shape[0] // LANE) * LANE
+    if npad != x.shape[0]:
+        x = jnp.pad(x, (0, npad - x.shape[0]))
+    br = min(rows_pad, _MAX_BLOCK_ROWS)
+    n_blocks = -(-rows_pad // br)
+    if n_blocks * br != rows_pad:
+        pad = n_blocks * br - rows_pad
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+        cols = jnp.pad(cols, ((0, pad), (0, 0)), constant_values=-1)
+    nnz_est = rows_pad * w
+    out = pl.pallas_call(
+        _spmv_kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((br, w), lambda i: (i, 0)),
+            pl.BlockSpec((br, w), lambda i: (i, 0)),
+            pl.BlockSpec((1, npad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, br), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_blocks, br), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * nnz_est,
+            # slabs read once, the operand re-read per block, rows written
+            bytes_accessed=(2 * nnz_est + n_blocks * npad + rows_pad) * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(vals, cols, x.reshape(1, npad))
+    return out.reshape(-1)[:rows_pad]
+
+
+def spmv_ell(vals: jax.Array, cols: jax.Array, x: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """``y[r] = Σ_j vals[r, j] * x[cols[r, j]]`` over one ELL slab pair
+    (pad lanes ``cols == -1`` contribute zero).  ``x`` is the full dense
+    operand ``(ncols,)``; the result covers all ``rows_pad`` slab rows —
+    the caller slices its logical rows.  Callers gate on
+    :func:`spmv_mode` first — this function assumes applicability."""
+    return _spmv_call(vals, cols, x, interpret)

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import devices as ht_devices
+from ..core import memtrack
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..parallel.mesh import MeshComm
@@ -79,6 +80,11 @@ class DCSR_matrix:
         self.__data = data          # (S, cap) sharded / (1, cap) replicated
         self.__indices = indices    # (S, cap) int32 global column ids
         self.__lindptr = lindptr    # (S, rows_per + 1) int32, rebased
+        # sparse residency enters the same exact ledger as dense
+        # DNDarrays: all three device buffers, tagged + site-attributed,
+        # so live_buffers()/census()/bytes_by_dtype see CSR slabs
+        for buf in (data, indices, lindptr):
+            memtrack.register_buffer(buf, tag="leaf", split=split)
         self.__lnnz = tuple(int(x) for x in lnnz)
         if int(gnnz) != sum(self.__lnnz):
             raise ValueError(
@@ -110,6 +116,11 @@ class DCSR_matrix:
             return self
         self.__data = self.__data[:, :need]
         self.__indices = self.__indices[:, :need]
+        # rebind: the trimmed slabs are NEW device buffers (and any
+        # derived spmv staging is stale)
+        memtrack.register_buffer(self.__data, tag="leaf", split=self.__split)
+        memtrack.register_buffer(self.__indices, tag="leaf", split=self.__split)
+        self._spmv_ell_cache = None
         return self
 
     # ---------------------------------------------------------- shard views
@@ -313,6 +324,8 @@ class DCSR_matrix:
             self.__data = new_data
             self.__dtype = dtype
             self._assembled_cache = None  # values changed in place
+            self._spmv_ell_cache = None   # ELL slabs carry stale values
+            memtrack.register_buffer(new_data, tag="leaf", split=self.__split)
             return self
         return DCSR_matrix._from_shards(
             new_data, self.__indices, self.__lindptr, self.__lnnz,
@@ -341,6 +354,11 @@ class DCSR_matrix:
 
         d, i, p = self._assemble()
         return scipy.sparse.csr_matrix((d, i, p), shape=self.__gshape)
+
+    def __matmul__(self, other):
+        from .matmul import matmul as _matmul
+
+        return _matmul(self, other)
 
     def __add__(self, other):
         from . import arithmetics

@@ -32,9 +32,18 @@ def sparse_csr_matrix(
     device=None,
     comm=None,
     split: Optional[int] = None,
+    min_row_cap: int = 0,
+    pow2_cap: bool = False,
 ) -> DCSR_matrix:
     """Build a DCSR_matrix from scipy CSR / dense array-likes (reference:
-    factories.py:23; torch or scipy input, split=0 row chunks)."""
+    factories.py:23; torch or scipy input, split=0 row chunks).
+
+    ``min_row_cap`` / ``pow2_cap`` stabilize the slab capacity for
+    serving: the capacity is raised to at least ``min_row_cap`` entries
+    per physical row and rounded to the next power of two, so matrices
+    of the same size class share compiled SpMV programs even as the
+    exact nnz drifts request-to-request (the shape-bucketed batching
+    rule applied to sparse payloads)."""
     comm = sanitize_comm(comm)
     device = ht_devices.sanitize_device(device)
 
@@ -82,7 +91,9 @@ def sparse_csr_matrix(
         ptrs[r, : len(reb)] = reb
         ptrs[r, len(reb) :] = reb[-1] if len(reb) else 0
         lnnz.append(int(sp.indptr[hi] - sp.indptr[lo]))
-    cap = max(1, max(lnnz, default=1))
+    cap = max(1, max(lnnz, default=1), int(min_row_cap) * max(rows_per, 1))
+    if pow2_cap:
+        cap = 1 << (int(cap) - 1).bit_length()
     datas = np.zeros((nsh, cap), sp.data.dtype)
     idxs = np.zeros((nsh, cap), np.int32)
     for r in range(nsh):

@@ -54,8 +54,17 @@ def _jit_scatter_local(nrows, ncols):
 
 def todense(sparse_matrix: DCSR_matrix, order: str = "C", out: Optional[DNDarray] = None) -> DNDarray:
     """Densify into a row-split DNDarray (reference: manipulations.py:15)."""
+    from ..core import telemetry
+
     nrows, ncols = sparse_matrix.shape
     comm = sparse_matrix.comm
+    # every densification is ledgered: the sparse-end-to-end contract
+    # (SpectralClustering.fit over a knn graph) is ASSERTED as zero of
+    # these events, not assumed
+    telemetry.record_event(
+        "sparse_densify", shape=(nrows, ncols), nnz=sparse_matrix.nnz,
+        split=sparse_matrix.split,
+    )
     if sparse_matrix.split == 0 and comm.size > 1:
         fn = _jit_scatter_sharded(
             comm.mesh, comm.split_axis, sparse_matrix.rows_per_shard, ncols

@@ -1,0 +1,500 @@
+"""Sparse matmul: the DCSR compute path, dispatched as measured
+autotune arms (ROADMAP item 6 — the sparse counterpart of the
+ring-vs-GSPMD and classic-vs-kernel consults).
+
+``matmul(A, x)`` computes ``A @ x`` for a row-split :class:`DCSR_matrix`
+against a dense vector/matrix.  Three arms per (sparsity-geometry
+fingerprint, device kind):
+
+``dense``
+    ``todense()`` + the ordinary matmul — the authoritative reference.
+    Explore always returns THIS arm's result, so numerics never depend
+    on tuning state (the round-15 explore contract).
+``gather``
+    Jitted segment-sum CSR matvec over the padded slabs (gather
+    ``x[cols]``, scatter-add per-entry products into the row outputs) —
+    runs on every backend, and is the static-dispatch default when the
+    tuning plane is off (``HEAT_TPU_SPMV`` overrides: ``dense`` /
+    ``gather`` / ``kernel``).
+``kernel``
+    The lane-aware Pallas ELL SpMV (:mod:`heat_tpu.ops.spmv`) with safe
+    decline: non-TPU backends (unless interpret is forced), non-f32
+    data, and VMEM-exceeding row blocks never register the arm.
+
+Each arm carries a telemetry cost-ledger row (``kind="spmv_*"`` with
+nnz-based FLOP/HBM models) so ``roofline_report()`` places the measured
+winner.  :func:`matvec_program` is the chain-consult path: it returns a
+jit-static ``(apply_fn, operands)`` pair for ``v ↦ A @ v`` inside a
+fused loop (Lanczos), consuming a resolved winner but never exploring —
+and never returning the ``dense`` arm, so a sparse solve stays sparse
+end-to-end (zero densifications of the operand).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache, partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core import autotune, telemetry, types
+from ..core.dndarray import DNDarray, _ensure_split
+from ..ops import spmv as spmv_kernel
+from ..parallel.collectives import shard_map_unchecked
+from ._operations import _expand_rows
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["matmul", "matvec_program"]
+
+
+# ----------------------------------------------------------- geometry cache
+
+
+def _geometry(A: DCSR_matrix) -> dict:
+    """Per-matrix sparsity geometry for dispatch: the max row nnz (the
+    ELL width driver) read once off the row pointers and cached on the
+    matrix — the structure is immutable even when values mutate in
+    place (``astype(copy=False)`` keeps indices/indptr)."""
+    geom = getattr(A, "_spmv_geom_cache", None)
+    if geom is not None:
+        return geom
+    # one device→host fetch of the (S, rows_per+1) pointer slab; the
+    # row-extent stat is structural metadata, same export class as
+    # shard_csr (nnz/lnnz_all sync points are host metadata already)
+    ptrs = np.asarray(A._lindptr)
+    max_row = int(np.diff(ptrs, axis=1).max()) if ptrs.size else 0
+    geom = {
+        "max_row": max_row,
+        "width": spmv_kernel.ell_width(max_row),
+    }
+    A._spmv_geom_cache = geom
+    return geom
+
+
+def _ell_slabs(A: DCSR_matrix) -> Tuple[jax.Array, jax.Array]:
+    """The matrix's ELL slabs ``(vals (S, rows_pad, W), cols ditto)``,
+    built host-side per shard on first kernel-arm use and cached on the
+    matrix; placed with the same row sharding as the CSR slabs."""
+    cached = getattr(A, "_spmv_ell_cache", None)
+    if cached is not None:
+        return cached
+    width = _geometry(A)["width"]
+    nsh = A.nshards if A.split == 0 else 1
+    # every shard pads to ONE row count (the ragged last shard would
+    # otherwise sublane-pad shorter and break the stacked slab)
+    rows_target = A.rows_per_shard if nsh > 1 else A.shape[0]
+    rows_pad = -(-max(rows_target, 1) // 8) * 8
+    vals_l, cols_l = [], []
+    for r in range(nsh):
+        d, i, p = A.shard_csr(r)
+        v, c = spmv_kernel.ell_pack(d, i, p, width)
+        if v.shape[0] < rows_pad:
+            grow = rows_pad - v.shape[0]
+            v = np.pad(v, ((0, grow), (0, 0)))
+            c = np.pad(c, ((0, grow), (0, 0)), constant_values=-1)
+        vals_l.append(v)
+        cols_l.append(c)
+    vals = np.stack(vals_l)
+    cols = np.stack(cols_l)
+    comm = A.comm
+    if A.split == 0 and comm.size > 1:
+        sh3 = comm.sharding(0, 3)
+    else:
+        sh3 = comm.replicated(3)
+    out = (
+        jax.device_put(jnp.asarray(vals), sh3),
+        jax.device_put(jnp.asarray(cols), sh3),
+    )
+    from ..core import memtrack
+
+    for buf in out:
+        memtrack.register_buffer(buf, tag="staging", split=A.split)
+    A._spmv_ell_cache = out
+    return out
+
+
+# ------------------------------------------------------------- gather arm
+
+
+def _gather_block(data, idx, ptr, x2, rows_per):
+    """One shard's CSR matvec as gather + scatter-add: per-entry products
+    ``data[e] * x[idx[e]]`` land in their row via ``.at[].add`` (pad
+    entries carry the sentinel row — ``mode="drop"`` discards them)."""
+    cap = data.shape[0]
+    rows = _expand_rows(ptr, cap, rows_per)
+    contrib = data[:, None] * jnp.take(x2, idx, axis=0)
+    out = jnp.zeros((rows_per, x2.shape[1]), contrib.dtype)
+    return out.at[rows].add(contrib, mode="drop")
+
+
+@lru_cache(maxsize=None)
+def _jit_gather_sharded(mesh, axis_name, rows_per):
+    spec = P(axis_name, None)
+
+    def local(data, idx, ptr, x2):
+        return _gather_block(data[0], idx[0], ptr[0], x2, rows_per)
+
+    return jax.jit(
+        shard_map_unchecked(
+            local, mesh,
+            in_specs=(spec, spec, spec, P(None, None)),
+            out_specs=P(axis_name, None),
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _jit_gather_local(rows_per):
+    return jax.jit(
+        lambda data, idx, ptr, x2: _gather_block(data, idx, ptr, x2, rows_per)
+    )
+
+
+def _run_gather(A: DCSR_matrix, x2: jax.Array) -> jax.Array:
+    n = A.shape[0]
+    if A.is_distributed():
+        fn = _jit_gather_sharded(A.comm.mesh, A.comm.split_axis, A.rows_per_shard)
+        y = fn(A._data, A._indices, A._lindptr, x2)
+    else:
+        fn = _jit_gather_local(A.shape[0])
+        y = fn(A._data[0], A._indices[0], A._lindptr[0], x2)
+    return y[:n]
+
+
+# ------------------------------------------------------------- kernel arm
+
+
+@lru_cache(maxsize=None)
+def _jit_kernel_sharded(mesh, axis_name, rows_per, interpret):
+    spec = P(axis_name, None, None)
+
+    def local(vals, cols, x2):
+        one = lambda xc: spmv_kernel.spmv_ell(
+            vals[0], cols[0], xc, interpret=interpret
+        )[:rows_per]
+        return jax.vmap(one, in_axes=1, out_axes=1)(x2)
+
+    return jax.jit(
+        shard_map_unchecked(
+            local, mesh,
+            in_specs=(spec, spec, P(None, None)),
+            out_specs=P(axis_name, None),
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _jit_kernel_local(rows, interpret):
+    def fn(vals, cols, x2):
+        one = lambda xc: spmv_kernel.spmv_ell(
+            vals[0], cols[0], xc, interpret=interpret
+        )[:rows]
+        return jax.vmap(one, in_axes=1, out_axes=1)(x2)
+
+    return jax.jit(fn)
+
+
+def _run_kernel(A: DCSR_matrix, x2: jax.Array, kmode: str) -> jax.Array:
+    n = A.shape[0]
+    vals, cols = _ell_slabs(A)
+    interp = kmode == "interpret"
+    if A.is_distributed():
+        fn = _jit_kernel_sharded(
+            A.comm.mesh, A.comm.split_axis, A.rows_per_shard, interp
+        )
+        y = fn(vals, cols, x2.astype(jnp.float32))
+    else:
+        fn = _jit_kernel_local(n, interp)
+        y = fn(vals, cols, x2.astype(jnp.float32))
+    return y[:n]
+
+
+# -------------------------------------------------------------- dense arm
+
+
+def _run_dense(A: DCSR_matrix, x2: jax.Array) -> jax.Array:
+    from . import manipulations
+
+    dense = manipulations.todense(A)
+    return jnp.matmul(dense.larray.astype(x2.dtype), x2)
+
+
+_ARM_RUNNERS = {"dense": _run_dense, "gather": _run_gather}
+
+
+# --------------------------------------------------------------- dispatch
+
+
+def _static_arm() -> str:
+    """Static dispatch when the tuning plane is off: ``HEAT_TPU_SPMV``
+    in ``dense`` / ``gather`` / ``kernel`` (default ``gather`` — the
+    every-backend sparse path); a malformed value raises, naming the
+    variable (the env_bytes strictness contract)."""
+    raw = os.environ.get("HEAT_TPU_SPMV", "").strip().lower()
+    if raw in ("", "auto", "gather"):
+        return "gather"
+    if raw in ("dense", "kernel"):
+        return raw
+    raise ValueError(
+        f"HEAT_TPU_SPMV must be auto|dense|gather|kernel, got {raw!r}"
+    )
+
+
+def _nnz_bucket(nnz: int) -> int:
+    """Power-of-two nnz bucket for the tuning key: the arm verdict is a
+    function of geometry class, not the exact count — without bucketing
+    every incremental graph would explore from scratch."""
+    return int(nnz).bit_length()
+
+
+def _site_programs(A: DCSR_matrix, k: int, width: int, dt: str) -> dict:
+    """Ensure one cost-ledger program row per arm (``kind="spmv_*"``,
+    nnz-based FLOP/HBM models) and return their fingerprints."""
+    n, ncols = A.shape
+    nnz = A.nnz
+    mesh = {"devices": A.comm.size}
+    rows_pad = -(-A.rows_per_shard // 8) * 8
+    nsh = A.nshards if A.split == 0 else 1
+    fps = {}
+    fps["dense"] = telemetry.fingerprint(("spmv_dense", n, ncols, k, dt))
+    telemetry.ensure_program(
+        fps["dense"], kind="spmv_dense", ops=2,
+        flops=2.0 * n * ncols * k,
+        hbm_bytes=float((n * ncols + ncols * k + n * k) * 4),
+        mesh=mesh, dtype=dt,
+    )
+    fps["gather"] = telemetry.fingerprint(("spmv_gather", n, ncols, k, nnz, dt))
+    telemetry.ensure_program(
+        fps["gather"], kind="spmv_gather", ops=1,
+        flops=2.0 * nnz * k,
+        hbm_bytes=float(nnz * 8 + ncols * k * 4 + n * k * 4),
+        mesh=mesh, dtype=dt,
+    )
+    fps["kernel"] = telemetry.fingerprint(
+        ("spmv_kernel", n, ncols, k, nnz, width, dt)
+    )
+    telemetry.ensure_program(
+        fps["kernel"], kind="spmv_kernel", ops=1,
+        flops=2.0 * nnz * k,
+        hbm_bytes=float(nsh * rows_pad * width * 8 + ncols * k * 4 + n * k * 4),
+        mesh=mesh, dtype=dt,
+    )
+    return fps
+
+
+def _dispatch(A: DCSR_matrix, x2: jax.Array) -> jax.Array:
+    n, ncols = A.shape
+    k = x2.shape[1]
+    geom = _geometry(A)
+    kmode = spmv_kernel.spmv_mode(
+        A.rows_per_shard, ncols, geom["max_row"], x2.dtype
+    )
+    kmode = kmode if jnp.dtype(A.dtype.jax_type()) == jnp.float32 else "off"
+    arms = autotune.SPMV_ARMS if kmode != "off" else ("dense", "gather")
+
+    if not autotune.enabled():
+        # static dispatch, bit-for-bit: no table touch, no decisions
+        arm = _static_arm()
+        if arm == "kernel":
+            if kmode == "off":
+                arm = "gather"
+            else:
+                return _run_kernel(A, x2, kmode)
+        return _ARM_RUNNERS[arm](A, x2)
+
+    dt = str(x2.dtype)
+    fps = _site_programs(A, k, geom["width"], dt)
+    key = autotune.spmv_key(
+        "spmv_csr", n, ncols, k, _nnz_bucket(A.nnz), A._data.shape[1],
+        geom["width"], dt, A.comm.size,
+    )
+    d = autotune.decide(
+        key, "gather",
+        desc=f"spmv {n}x{ncols} nnz={A.nnz} k={k} {dt}", arms=arms,
+    )
+    if d.explore:
+        out_d, t_d = autotune.timed(_run_dense, A, x2)
+        _, t_g = autotune.timed(_run_gather, A, x2)
+        autotune.observe(key, "dense", t_d)
+        autotune.observe(key, "gather", t_g)
+        telemetry.record_timing(fps["dense"], t_d)
+        telemetry.record_timing(fps["gather"], t_g)
+        if "kernel" in arms:
+            _, t_k = autotune.timed(_run_kernel, A, x2, kmode)
+            autotune.observe(key, "kernel", t_k)
+            telemetry.record_timing(fps["kernel"], t_k)
+        return out_d  # the reference arm's result, always
+    if d.arm == "kernel" and kmode != "off":
+        return telemetry.timed_call(
+            fps["kernel"], _run_kernel, A, x2, kmode,
+            observer=partial(autotune.observe, key, "kernel"),
+        )
+    arm = d.arm if d.arm in _ARM_RUNNERS else "gather"
+    return telemetry.timed_call(
+        fps[arm], _ARM_RUNNERS[arm], A, x2,
+        observer=partial(autotune.observe, key, arm),
+    )
+
+
+# ------------------------------------------------------------- public API
+
+
+def matmul(A: DCSR_matrix, x, out: Optional[DNDarray] = None) -> DNDarray:
+    """``A @ x`` for a DCSR matrix against a dense vector/matrix.  The
+    result is a dense DNDarray (row-split when ``A`` is distributed);
+    dispatch is the three-arm autotune consult described in the module
+    docstring."""
+    if not isinstance(A, DCSR_matrix):
+        raise TypeError(f"A must be a DCSR_matrix, got {type(A)}")
+    xv = x.larray if isinstance(x, DNDarray) else jnp.asarray(x)
+    if xv.ndim not in (1, 2):
+        raise ValueError(f"x needs to be 1-D or 2-D, but was {xv.ndim}-D")
+    if xv.shape[0] != A.shape[1]:
+        raise ValueError(
+            f"dimension mismatch: A is {A.shape}, x leads with {xv.shape[0]}"
+        )
+    cdt = jnp.promote_types(A.dtype.jax_type(), xv.dtype)
+    if not jnp.issubdtype(cdt, jnp.inexact):
+        cdt = jnp.float32
+    vec = xv.ndim == 1
+    x2 = (xv[:, None] if vec else xv).astype(cdt)
+
+    y = _dispatch(A, x2)
+    if vec:
+        y = y.reshape(-1)
+    split = 0 if A.split == 0 else None
+    result = DNDarray(
+        y, tuple(y.shape), types.canonical_heat_type(y.dtype),
+        None, A.device, A.comm,
+    )
+    result = _ensure_split(result, split)
+    if out is not None:
+        from ..core import sanitation
+
+        sanitation.sanitize_out(out, result.shape, result.split, result.device)
+        out.larray = result.larray.astype(out.dtype.jax_type())
+        return out
+    return result
+
+
+# --------------------------------------------------- chain (Lanczos) consult
+
+
+def _matvec_gather_sharded_ops(A: DCSR_matrix):
+    return (A._data, A._indices, A._lindptr)
+
+
+@lru_cache(maxsize=None)
+def _matvec_gather_sharded(mesh, axis_name, rows_per, n):
+    spec = P(axis_name, None)
+
+    def local(data, idx, ptr, v):
+        return _gather_block(data[0], idx[0], ptr[0], v[:, None], rows_per)[:, 0]
+
+    sm = shard_map_unchecked(
+        local, mesh,
+        in_specs=(spec, spec, spec, P(None)), out_specs=P(axis_name),
+    )
+
+    def apply(operands, v):
+        return sm(*operands, v)[:n]
+
+    return apply
+
+
+@lru_cache(maxsize=None)
+def _matvec_gather_local(rows, n):
+    def apply(operands, v):
+        data, idx, ptr = operands
+        return _gather_block(data, idx, ptr, v[:, None], rows)[:n, 0]
+
+    return apply
+
+
+@lru_cache(maxsize=None)
+def _matvec_kernel_sharded(mesh, axis_name, rows_per, n, interpret):
+    spec = P(axis_name, None, None)
+
+    def local(vals, cols, v):
+        return spmv_kernel.spmv_ell(
+            vals[0], cols[0], v, interpret=interpret
+        )[:rows_per]
+
+    sm = shard_map_unchecked(
+        local, mesh,
+        in_specs=(spec, spec, P(None)), out_specs=P(axis_name),
+    )
+
+    def apply(operands, v):
+        return sm(*operands, v)[:n]
+
+    return apply
+
+
+@lru_cache(maxsize=None)
+def _matvec_kernel_local(n, interpret):
+    def apply(operands, v):
+        vals, cols = operands
+        return spmv_kernel.spmv_ell(
+            vals[0], cols[0], v, interpret=interpret
+        )[:n]
+
+    return apply
+
+
+def matvec_program(A: DCSR_matrix):
+    """Jit-static ``(apply_fn, operands)`` for ``v ↦ A @ v`` inside a
+    fused loop.  The chain-consult contract (autotune module docstring):
+    a resolved ``kernel``/``gather`` winner is consumed, anything else
+    falls back to the ``gather`` prior with a recorded ``note_prior`` —
+    a fused solve never explores and never densifies, so the ``dense``
+    arm is deliberately unreachable here."""
+    n, ncols = A.shape
+    geom = _geometry(A)
+    kmode = spmv_kernel.spmv_mode(
+        A.rows_per_shard, ncols, geom["max_row"], jnp.float32
+    )
+    kmode = kmode if jnp.dtype(A.dtype.jax_type()) == jnp.float32 else "off"
+
+    arm = "gather"
+    if autotune.enabled():
+        key = autotune.spmv_key(
+            "spmv_csr", n, ncols, 1, _nnz_bucket(A.nnz), A._data.shape[1],
+            geom["width"], str(jnp.dtype(jnp.float32)), A.comm.size,
+        )
+        w = autotune.winner(key)
+        if w == "kernel" and kmode != "off":
+            arm = "kernel"
+        elif w == "gather":
+            arm = "gather"
+        else:
+            autotune.note_prior(key, "gather", site="lanczos")
+    else:
+        static = _static_arm()
+        if static == "kernel" and kmode != "off":
+            arm = "kernel"
+
+    if arm == "kernel":
+        operands = _ell_slabs(A)
+        if A.is_distributed():
+            fn = _matvec_kernel_sharded(
+                A.comm.mesh, A.comm.split_axis, A.rows_per_shard, n,
+                kmode == "interpret",
+            )
+        else:
+            fn = _matvec_kernel_local(n, kmode == "interpret")
+        return fn, operands
+    operands = _matvec_gather_sharded_ops(A)
+    if A.is_distributed():
+        fn = _matvec_gather_sharded(
+            A.comm.mesh, A.comm.split_axis, A.rows_per_shard, n
+        )
+    else:
+        fn = _matvec_gather_local(A.shape[0], n)
+        operands = (A._data[0], A._indices[0], A._lindptr[0])
+    return fn, operands

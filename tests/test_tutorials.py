@@ -39,3 +39,26 @@ class TestTutorials(TestCase):
 
     def test_tutorial_training(self):
         self._run_doc("tutorial_training.md")
+
+    def test_quick_start_go_sparse(self):
+        """quick_start.md section 17 ("Go sparse") executes top to
+        bottom — the residency-ratio and zero-densification claims in
+        the doc are live assertions, not prose."""
+        from heat_tpu.core import telemetry
+
+        text = open(os.path.join(DOCS, "quick_start.md"), encoding="utf-8").read()
+        m = re.search(r"## 17\. Go sparse\n(.*?)\n## 18\.", text, re.S)
+        self.assertIsNotNone(m, "quick_start.md lost its 'Go sparse' section")
+        blocks = re.findall(r"```python\n(.*?)```", m.group(1), re.S)
+        self.assertGreaterEqual(len(blocks), 4, "Go sparse lost its code blocks")
+        prev_level = telemetry.set_level("off")
+        try:
+            ns = {}
+            for i, block in enumerate(blocks):
+                try:
+                    exec(compile(block, f"quick_start.md[sparse block {i}]", "exec"), ns)
+                except Exception as e:
+                    self.fail(f"Go sparse block {i} failed: {e}\n---\n{block}")
+        finally:
+            telemetry.set_level(prev_level)
+            telemetry.clear_events()
