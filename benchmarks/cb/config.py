@@ -222,3 +222,14 @@ SPMV_RHS_K = 4
 KNNG_N, KNNG_F, KNNG_K = (65_536, 16, 6) if ON_TPU else (512, 8, 6)
 KNNG_LANCZOS = 32 if ON_TPU else 16
 KNNG_REQS = 128 if ON_TPU else 36
+# out-of-core streaming rows (round 22): KMeans fit on a FILE-BACKED
+# corpus exactly 4x the residency budget (>=4 slabs per pass, so the
+# double-buffered prefetch has real boundaries to hide), and a streamed
+# k-NN corpus behind the bucketed serving front door.  Sized so the CPU
+# fit stays in seconds; the headline the rows vouch for is the ledgered
+# peak staging bytes <= budget, the centroid parity bound and the
+# measured prefetch overlap — the wall rides host I/O scheduling
+STREAM_N, STREAM_F, STREAM_K = (4_194_304, 64, 8) if ON_TPU else (16_384, 32, 4)
+STREAM_ITERS = 5
+STREAM_KNN_N, STREAM_KNN_F = (262_144, 64) if ON_TPU else (2_048, 32)
+STREAM_REQS = 128 if ON_TPU else 32

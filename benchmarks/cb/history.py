@@ -109,6 +109,15 @@ TOLERANCE = {
     # single-run batched wall over a thread pool, same contract as
     # serving_batch: Python thread scheduling rides the number
     "serving_knn_graph": 0.5,
+    # round-20 streaming rows (stream.py's own notes): single-run walls
+    # whose timed region is dominated by host file I/O and the prefetch
+    # thread contending with the consumer for the same CPU cores — the
+    # headline each row vouches for (peak staging <= budget, centroid
+    # parity, zero step compiles) is ASSERTED inside the workload, and
+    # the ci.sh stage-23 gate re-checks it; the wall rides the OS page
+    # cache and thread scheduling
+    "stream_kmeans": 0.5,
+    "stream_knn_serving": 0.5,
 }
 
 _ROUND_RE = re.compile(r"BENCH_cb_r(\d+)\.json$")

@@ -21,6 +21,7 @@ import regression
 import router
 import serving
 import sparse
+import stream
 import wire
 
 from heat_tpu.core import telemetry as _telemetry
@@ -95,7 +96,7 @@ if __name__ == "__main__":
         default=None,
         help="comma-separated subset: "
              "linalg,cluster,manipulations,nn,regression,fusion,kernels,"
-             "serving,router,quantize,wire,sparse",
+             "serving,router,quantize,wire,sparse,stream",
     )
     ap.add_argument(
         "--check-regression",
@@ -119,6 +120,7 @@ if __name__ == "__main__":
         "router": router.run,
         "serving": serving.run,
         "sparse": sparse.run,
+        "stream": stream.run,
         "wire": wire.run,
     }
     selected = (

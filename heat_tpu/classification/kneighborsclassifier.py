@@ -32,6 +32,11 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
         self.y = None
         self.classes_ = None
         self._qx = None  # quantized corpus (quantize_()); replaces self.x
+        self._stream_src = None  # out-of-core corpus handle (fit_stream())
+        self._stream_own = False
+        self._stream_plan = None
+        self._stream_budget = None
+        self.last_stream_report = None
 
     @staticmethod
     def one_hot_encoding(x: DNDarray) -> DNDarray:
@@ -99,9 +104,122 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
         self.x = None  # release the master — the residency win
         return self
 
+    def fit_stream(self, source, y, dataset: Optional[str] = None, *,
+                   comm=None, budget=None) -> "KNeighborsClassifier":
+        """Fit on a corpus that does not fit in HBM: store the chunk-source
+        HANDLE, not the data.  ``predict`` then streams the corpus past the
+        (device-resident) queries once per call, carrying a running best-k
+        per query through :func:`distance._stream_topk_merge` — labels
+        match the in-memory predict bitwise wherever distances are exact
+        (same squared-distance kernel, same stable-tie ``top_k``).
+
+        ``y`` is in-memory (class indices, 1-D, or one-hot, 2-D): the
+        label table is a vector-sized side input the votes gather from by
+        global corpus id, so it stays replicated on device.  The source
+        handle stays open across predicts; :meth:`close_stream` releases
+        it."""
+        import numpy as np
+
+        from ..core import factories, stream
+        from ..parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(comm)
+        src = stream.open_source(source, dataset=dataset,
+                                 np_dtype=np.float32)
+        if len(src.shape) != 2:
+            raise ValueError(
+                f"corpus needs to be 2-D, but was {len(src.shape)}-D"
+            )
+        n = src.shape[0]
+        y_host = np.asarray(y.larray if isinstance(y, DNDarray) else y)
+        if y_host.shape[0] != n:
+            raise ValueError(
+                f"Number of samples x and y samples mismatch: {n} != {y_host.shape[0]}"
+            )
+        if y_host.ndim == 1:
+            classes = np.unique(y_host)
+            self.classes_ = factories.array(classes, split=None, comm=comm)
+            onehot = (y_host[:, None] == classes[None, :]).astype(np.float32)
+        else:
+            self.classes_ = None
+            onehot = y_host.astype(np.float32)
+        # replicated: votes gather rows by GLOBAL corpus id
+        self.y = factories.array(onehot, split=None, comm=comm)
+        self.close_stream()
+        self._stream_src = src
+        self._stream_own = src is not source
+        self._stream_plan = None
+        self._stream_budget = budget
+        self.x = None
+        self._qx = None
+        return self
+
+    def close_stream(self) -> None:
+        """Release the out-of-core corpus handle (no-op when not streaming
+        or when the caller owns the :class:`stream.ChunkSource`)."""
+        if self._stream_src is not None and self._stream_own:
+            self._stream_src.close()
+        self._stream_src = None
+        self._stream_plan = None
+
+    def _predict_stream(self, x: DNDarray) -> DNDarray:
+        from ..core import stream, telemetry
+
+        src = self._stream_src
+        if self._stream_plan is None:
+            # plan ONCE and reuse: a stable slab_rows keeps every later
+            # predict in the slab bucket warmed by the first (no-retrace
+            # law behind the serving front door)
+            self._stream_plan = stream.plan_pass(
+                src, comm=x.comm, site="knn_predict",
+                budget=self._stream_budget,
+            )
+        pl = self._stream_plan
+        q = x.larray
+        if not jnp.issubdtype(q.dtype, jnp.floating):
+            q = q.astype(jnp.float32)
+        k = self.n_neighbors
+        nq = q.shape[0]
+        best_d = jnp.full((nq, k), jnp.inf, jnp.float32)
+        best_i = jnp.zeros((nq, k), jnp.int32)
+        sp = stream.StreamPass(src, comm=x.comm, plan=pl)
+        for slab in sp:
+            best_d, best_i = distance._stream_topk_merge(
+                q, slab.x.larray, slab.valid, slab.base, best_d, best_i, k
+            )
+            del slab  # drop the loop reference: 3-slab residency cap
+        rep = stream.finish_pass(sp)
+        self.last_stream_report = dict(rep, arm=pl.arm, budget=pl.budget)
+        n, f = src.shape
+        fp = telemetry.fingerprint(
+            ("stream_knn", pl.slab_rows, f, k, nq, x.comm.size)
+        )
+        telemetry.ensure_program(
+            fp, kind="stream_knn", dtype="float32",
+            flops=2.0 * n * f * nq, hbm_bytes=float(n) * f * 4,
+        )
+        telemetry.record_timing(fp, rep["wall_s"])
+        telemetry.annotate_program(
+            fp, io_stall_frac=round(1.0 - rep["overlap_frac"], 4),
+            io_bytes=rep["bytes_read"],
+        )
+        votes = jnp.sum(self.y.larray[best_i], axis=1)
+        winner = jnp.argmax(votes, axis=1)
+        if self.classes_ is not None:
+            labels = self.classes_.larray[winner]
+        else:
+            labels = winner
+        out = DNDarray(
+            labels, tuple(labels.shape), types.canonical_heat_type(labels.dtype),
+            x.split, x.device, x.comm,
+        )
+        return _ensure_split(out, x.split)
+
     def predict(self, x: DNDarray) -> DNDarray:
         """Majority vote over the k nearest training samples (reference:
         kneighborsclassifier.py:117)."""
+        if self._stream_src is not None:
+            return self._predict_stream(x)
         if self.x is None and self._qx is None:
             raise RuntimeError("fit the model first")
         if self._qx is not None:

@@ -113,6 +113,31 @@ def _lloyd_step(x, centers, k: int):
     return new_centers, shift, inertia
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _stream_lloyd_stats(x, valid, centers, k: int):
+    """Per-slab Lloyd sufficient statistics for the out-of-core path:
+    (masked counts, masked sums, masked inertia) against FIXED centers.
+
+    Same math as :func:`_lloyd_step` — f32 count/sum accumulation, row-min
+    inertia — restricted to rows ``[0, valid)`` (the streaming engine
+    zero-pads slab tails to keep one compiled bucket per pass; ``valid``
+    arrives as a Python int and traces as a weak scalar, so tail slabs hit
+    the same executable).  The center UPDATE happens host-side in
+    ``fit_stream`` after all slabs of a pass are folded together."""
+    x = x.astype(centers.dtype)
+    d2 = ops_cdist(x, centers, sqrt=False)
+    labels = jnp.argmin(d2, axis=1)
+    mask = jnp.arange(x.shape[0]) < valid
+    onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(x.dtype)
+    onehot = onehot * mask[:, None].astype(x.dtype)
+    counts = jnp.sum(onehot, axis=0, dtype=jnp.float32)
+    sums = jax.lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    inertia = jnp.sum(jnp.where(mask, jnp.min(d2, axis=1), 0.0))
+    return counts, sums, inertia
+
+
 @partial(jax.jit, static_argnames=("k", "p", "with_inertia"))
 def _lloyd_loop_packed(x2, sq, valid, centers, k: int, p: int, max_iter, tol,
                        with_inertia: bool = True):
@@ -605,6 +630,151 @@ class KMeans(_KCluster):
         if isinstance(x, PackedSamples):
             return self._predict_packed(x)
         return super().predict(x)
+
+    # ------------------------------------------------------ streaming path
+    def _init_centers_stream(self, src, comm) -> jax.Array:
+        """Initial centroids off a chunk source (bounded host reads only).
+
+        Mirrors :meth:`_init_centers_packed`'s strategies: explicit
+        centroids pass through; "random" is the stratified per-cluster
+        draw, each chosen row host-read individually; "kmeans++" seeds on
+        a bounded sample prefix (2^18 rows) — an exact scan would stream
+        the whole array k times for a seeding a large subsample matches
+        statistically."""
+        import numpy as np
+
+        from ..core import random as ht_random
+
+        k = self.n_clusters
+        n, f = src.shape
+        if n < k:
+            raise ValueError(f"n_samples={n} should be >= n_clusters={k}")
+        if isinstance(self.init, DNDarray):
+            if self.init.shape != (k, f):
+                raise ValueError(
+                    "passed centroids do not match cluster count or data shape"
+                )
+            return self.init.resplit(None).larray.astype(jnp.float32)
+        if self.random_state is not None:
+            ht_random.seed(self.random_state)
+        us = ht_random.rand(k, comm=comm).larray.astype(jnp.float32)
+        if isinstance(self.init, str) and self.init == "random":
+            width = max(n // k, 1)
+            lo = np.arange(k) * (n // k)
+            off = (np.asarray(us) * width).astype(np.int64)  # ht: HT002 ok — k uniforms read once at init
+            idx = np.minimum(lo + off, n - 1)
+            rows = np.concatenate([src.read(int(i), int(i) + 1) for i in idx])
+            return jnp.asarray(rows, jnp.float32)
+        if isinstance(self.init, str) and self.init in (
+            "probability_based", "kmeans++", "kmedians++",
+        ):
+            from ._kcluster import _kmeanspp_init
+
+            sub = jnp.asarray(src.read(0, min(n, 1 << 18)), jnp.float32)
+            return _kmeanspp_init(sub, us, k)
+        raise ValueError(f"unsupported init for streamed data: {self.init!r}")
+
+    @telemetry.span("kmeans.fit_stream")
+    def fit_stream(self, source, dataset: Optional[str] = None, *,
+                   comm=None, budget: Optional[int] = None) -> "KMeans":
+        """Exact multi-pass Lloyd over data that does not fit in HBM.
+
+        Each Lloyd iteration is ONE streaming pass (core/stream.py):
+        slabs arrive double-buffered under the residency budget, the
+        jitted :func:`_stream_lloyd_stats` folds each into running
+        (counts, sums, inertia) — compiled once per pass, the slab shape
+        is fixed — and the center update + one scalar shift readback
+        happen between passes.  The result is the same Lloyd fixed point
+        as :meth:`fit` on the in-memory array (f32 accumulation; only
+        the slab-wise summation order differs, so centroids agree to
+        accumulation roundoff).  ``self.labels_`` stays ``None`` — a
+        labels pass over out-of-core data is a separate full read the
+        caller can run via chunked ``predict`` when actually wanted.
+
+        ``source`` is anything :func:`heat_tpu.core.stream.open_source`
+        accepts (HDF5/NetCDF path + ``dataset``, ``.npy``, ndarray, open
+        ``ChunkSource``); ``budget`` overrides the measured residency
+        budget in bytes."""
+        import numpy as np
+
+        from ..core import stream
+
+        from ..parallel.mesh import sanitize_comm
+
+        comm = sanitize_comm(comm)
+        src = stream.open_source(source, dataset=dataset,
+                                 np_dtype=np.float32)
+        own = src is not source  # passthrough ChunkSource stays caller-owned
+        try:
+            if len(src.shape) != 2:
+                raise ValueError(
+                    f"input needs to be 2-D, but was {len(src.shape)}-D"
+                )
+            n, f = src.shape
+            k = self.n_clusters
+            centers = self._init_centers_stream(src, comm)
+            inertia = 0.0
+            self._n_iter = 0
+            self.last_stream_report = None
+            for _ in range(self.max_iter):
+                pl = stream.plan_pass(src, comm=comm, site="kmeans_fit",
+                                      budget=budget)
+                sp = stream.StreamPass(src, comm=comm, plan=pl)
+                counts = jnp.zeros((k,), jnp.float32)
+                sums = jnp.zeros((k, f), jnp.float32)
+                pass_inertia = jnp.zeros((), jnp.float32)
+                for slab in sp:
+                    c, s, i = _stream_lloyd_stats(
+                        slab.x.larray, slab.valid, centers, k
+                    )
+                    counts = counts + c
+                    sums = sums + s
+                    pass_inertia = pass_inertia + i
+                    del slab  # drop the loop reference: 3-slab residency cap
+                rep = stream.finish_pass(sp)
+                self.last_stream_report = dict(rep, arm=pl.arm,
+                                               budget=pl.budget)
+                fp = telemetry.fingerprint(
+                    ("stream_kmeans", pl.slab_rows, f, k, comm.size)
+                )
+                telemetry.ensure_program(
+                    fp, kind="stream_kmeans", dtype="float32",
+                    flops=4.0 * n * f * k, hbm_bytes=float(n) * f * 4,
+                )
+                telemetry.record_timing(fp, rep["wall_s"])
+                telemetry.annotate_program(
+                    fp,
+                    io_stall_frac=round(1.0 - rep["overlap_frac"], 4),
+                    io_bytes=rep["bytes_read"],
+                )
+                new_centers = jnp.where(
+                    counts[:, None] > 0,
+                    sums / jnp.maximum(counts, 1)[:, None],
+                    centers.astype(jnp.float32),
+                ).astype(centers.dtype)
+                shift = float(  # ht: HT002 ok — one convergence scalar per full-data pass
+                    jnp.sum((new_centers - centers).astype(jnp.float32) ** 2)
+                )
+                centers = new_centers
+                inertia = float(pass_inertia)  # ht: HT002 ok — rides the shift sync, last pass's value is inertia_
+                self._n_iter += 1
+                if shift <= self.tol:
+                    break
+        finally:
+            if own:
+                src.close()
+        from ..core.devices import sanitize_device
+
+        self._cluster_centers = DNDarray(
+            centers, tuple(centers.shape),
+            types.canonical_heat_type(centers.dtype), None,
+            sanitize_device(None), comm,
+        )
+        # dense-path definition: last iteration's assignment distances
+        # against pre-update centers (see fit); labels stay out-of-core
+        self._inertia = inertia
+        self._labels = None
+        return self
 
 
 # row-block size for the near-HBM-ceiling paths: temporaries per block

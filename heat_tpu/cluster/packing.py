@@ -194,41 +194,39 @@ def load_hdf5_packed(
     (a block of whole packed rows) is reshaped (rows_blk, p*f) before it
     lands on its device — the lane-padded (n, f) form never exists
     (reference loader: io.py:57; sharded slab path: core/io.py:86)."""
-    import h5py
-
     from ..core import io as ht_io
+    from ..core import stream
     import numpy as np
 
     if split != 0:
         raise ValueError("packed loads are row-sharded: split must be 0")
     ht = types.canonical_heat_type(dtype)
-    with h5py.File(path, "r") as handle:
-        n, f = handle[dataset].shape
-    if not packable(f, ht):
-        raise ValueError(f"cannot lane-pack f={f}, dtype={ht.__name__}")
-    p = 128 // f
-    rows = -(-n // p)
-
     np_dtype = types._np_equivalent(ht)
+    # shared chunk reader (core/stream.py): one open handle for the whole
+    # load instead of the old reopen-per-slab, one copy of the slab math
+    with stream.open_source(path, dataset=dataset, np_dtype=np_dtype) as src:
+        n, f = src.shape
+        if not packable(f, ht):
+            raise ValueError(f"cannot lane-pack f={f}, dtype={ht.__name__}")
+        p = 128 // f
+        rows = -(-n // p)
 
-    def read_packed_slab(lo: int, hi: int) -> "np.ndarray":
-        # packed rows [lo, hi) = samples [lo*p, min(hi*p, n))
-        with h5py.File(path, "r") as handle:
-            chunk = handle[dataset][lo * p : min(hi * p, n)]
-        chunk = np.asarray(chunk, np_dtype)
-        if chunk.shape[0] < (hi - lo) * p:  # zero-pad tail slots
-            padr = (hi - lo) * p - chunk.shape[0]
-            chunk = np.concatenate([chunk, np.zeros((padr, f), np_dtype)])
-        return chunk.reshape(hi - lo, p * f)
+        def read_packed_slab(lo: int, hi: int) -> "np.ndarray":
+            # packed rows [lo, hi) = samples [lo*p, min(hi*p, n))
+            chunk = src.read(lo * p, min(hi * p, n))
+            if chunk.shape[0] < (hi - lo) * p:  # zero-pad tail slots
+                padr = (hi - lo) * p - chunk.shape[0]
+                chunk = np.concatenate([chunk, np.zeros((padr, f), np_dtype)])
+            return chunk.reshape(hi - lo, p * f)
 
-    from ..core.devices import sanitize_device
-    from ..parallel.mesh import sanitize_comm
+        from ..core.devices import sanitize_device
+        from ..parallel.mesh import sanitize_comm
 
-    comm = sanitize_comm(comm)
-    device = sanitize_device(device)
-    x2 = ht_io._assemble_sharded(
-        read_packed_slab, (rows, p * f), np_dtype, 0, device, comm
-    )
+        comm = sanitize_comm(comm)
+        device = sanitize_device(device)
+        x2 = ht_io._assemble_sharded(
+            read_packed_slab, (rows, p * f), np_dtype, 0, device, comm
+        )
     if x2.dtype is not ht:
         x2 = x2.astype(ht)
     return PackedSamples(x2, n, f)

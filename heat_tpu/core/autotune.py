@@ -81,6 +81,8 @@ __all__ = [
     "SPMV_ARMS",
     "spmv_key",
     "stats",
+    "STREAM_ARMS",
+    "stream_key",
     "table",
     "WIRE_ARMS",
     "winner",
@@ -117,11 +119,20 @@ WIRE_ARMS = ("wire_f32", "wire_int8", "wire_fp8")
 # near-full matrices, gather wins tiny ones, the kernel wins the
 # lane-friendly middle).
 SPMV_ARMS = ("dense", "gather", "kernel")
+# round 22: the out-of-core streaming engine (core/stream.py) — the arms
+# are SLAB SIZES, not lowerings: "slab_full" is the budget-derived
+# maximum slab (budget//2 rows, two slabs live under double buffering),
+# "slab_half"/"slab_quarter" trade residency for pipeline granularity
+# (smaller slabs hide host reads better when the device step is short).
+# Every arm computes the identical result — explore runs the chosen arm
+# and observes its pass wall, so the tuner converges on whichever slab
+# maximizes prefetch overlap for this (source geometry, device kind).
+STREAM_ARMS = ("slab_full", "slab_half", "slab_quarter")
 # every arm name any entry may carry; load() refuses winners outside it
 # so a corrupt cache cannot inject an undispatched arm
 _KNOWN_ARMS = (
     frozenset(ARMS) | frozenset(KERNEL_ARMS) | frozenset(QUANT_ARMS)
-    | frozenset(WIRE_ARMS) | frozenset(SPMV_ARMS)
+    | frozenset(WIRE_ARMS) | frozenset(SPMV_ARMS) | frozenset(STREAM_ARMS)
 )
 CACHE_VERSION = 1
 
@@ -391,6 +402,18 @@ def wire_key(site: str, *geometry) -> Tuple[str, str]:
     returns bitwise) vs "wire_int8"/"wire_fp8" (absmax-scaled tiles on
     the wire, f32 scales beside them, dequantized on landing)."""
     fp = telemetry.fingerprint(("wire", site) + tuple(geometry))
+    return fp, device_kind()
+
+
+def stream_key(site: str, *geometry) -> Tuple[str, str]:
+    """Tuning-table key for one out-of-core streaming pass
+    (``kmeans_fit`` / ``gnb_fit`` / ``knn_predict`` — core/stream.py) at
+    one source geometry (total rows, features, dtype, mesh size, budget
+    bucket).  The entry's arms are :data:`STREAM_ARMS`: fractions of the
+    budget-derived maximum slab.  All arms are numerically identical —
+    the tuner is picking the slab size that best hides host I/O behind
+    device compute, so each pass runs ONE arm and observes its wall."""
+    fp = telemetry.fingerprint(("stream", site) + tuple(geometry))
     return fp, device_kind()
 
 

@@ -20,7 +20,7 @@ from typing import Callable, Optional, Union
 import jax
 import numpy as np
 
-from . import devices, factories, types
+from . import devices, factories, stream, types
 from .dndarray import DNDarray, _physical_dim, _split_axis_shards
 from ..parallel.mesh import sanitize_comm
 
@@ -236,12 +236,11 @@ def load_hdf5(
                 arr, dtype=dtype, split=split, device=device, comm=comm
             )
         split_ = split % len(gshape)
-        bs = base[split_]
 
+        # shared chunk reader (core/stream.py): the one copy of the
+        # rank-local slab math, honoring the user slices' step
         def read_slab(lo: int, hi: int) -> np.ndarray:
-            sel = list(base)
-            sel[split_] = slice(bs.start + lo * bs.step, bs.start + hi * bs.step, bs.step)
-            return _read_region(dset, tuple(sel))
+            return stream.read_rows(dset, lo, hi, split_axis=split_, base=base)
 
         return _assemble_sharded(read_slab, gshape, np_dtype, split_, device, comm)
 
@@ -306,11 +305,8 @@ def load_netcdf(
         split_ = split % len(gshape)
 
         def read_slab(lo: int, hi: int) -> np.ndarray:
-            sel = tuple(
-                slice(lo, hi) if d == split_ else slice(0, n)
-                for d, n in enumerate(gshape)
-            )
-            return np.array(_read_region(var, sel))
+            # copy=True: slabs must not stay views into scipy's file mmap
+            return stream.read_rows(var, lo, hi, split_axis=split_, copy=True)
 
         return _assemble_sharded(read_slab, gshape, np_dtype, split_, device, comm)
     finally:
@@ -534,11 +530,7 @@ def load_npy(path: str, dtype=None, split: Optional[int] = None, device=None, co
             )
 
             def read_slab(lo: int, hi: int) -> np.ndarray:
-                sel = tuple(
-                    slice(lo, hi) if d == split_ else slice(0, n)
-                    for d, n in enumerate(gshape)
-                )
-                return np.array(_read_region(arr, sel))
+                return stream.read_rows(arr, lo, hi, split_axis=split_, copy=True)
 
             return _assemble_sharded(read_slab, gshape, np_dtype, split_, device, comm)
         arr = np.array(arr)

@@ -113,6 +113,13 @@ _PEAK_LIVE = [0]        # high-water mark of _LIVE_BYTES
 _REG_SEQ = [0]          # registration counter (memwatch scope marker)
 _DEVICE_PEAKS: Dict[str, int] = {}   # device str -> max sampled bytes_in_use
 _WATCH_RETAINED: List[dict] = []     # survivors of the last memwatch() scope
+# per-tag live bytes + their high-water marks, maintained incrementally
+# (register/retag/drop) rather than derived from _LEDGER: a derived scan
+# only sees the tag at snapshot time, but the streaming engine's budget
+# proof needs the PEAK "staging" residency — the most slab bytes ever
+# simultaneously live — which only an incremental counter can record
+_LIVE_BY_TAG: Dict[str, int] = {}
+_PEAK_BY_TAG: Dict[str, int] = {}
 
 
 def _reset_state() -> None:
@@ -122,6 +129,15 @@ def _reset_state() -> None:
     _REG_SEQ[0] = 0
     _DEVICE_PEAKS.clear()
     _WATCH_RETAINED.clear()
+    _LIVE_BY_TAG.clear()
+    _PEAK_BY_TAG.clear()
+
+
+def _tag_add(tag: str, nbytes: int) -> None:
+    live = _LIVE_BY_TAG.get(tag, 0) + nbytes
+    _LIVE_BY_TAG[tag] = live
+    if live > _PEAK_BY_TAG.get(tag, 0):
+        _PEAK_BY_TAG[tag] = live
 
 
 def summary() -> dict:
@@ -142,6 +158,9 @@ def summary() -> dict:
         # per-dtype residency: the one-snapshot answer to "what did
         # quantizing the weights actually buy" (int8 vs f32/bf16 bytes)
         "bytes_by_dtype": by_dtype,
+        # high-water marks per tag: "staging" is the streaming engine's
+        # proof that double-buffered slabs never exceeded their budget
+        "peak_bytes_by_tag": dict(_PEAK_BY_TAG),
         "device_peak_bytes": dict(_DEVICE_PEAKS),
     }
 
@@ -176,6 +195,7 @@ def _drop(buf_id: int) -> None:
     if rec is None:
         return
     _LIVE_BYTES[0] -= rec["nbytes"]
+    _LIVE_BY_TAG[rec["tag"]] = _LIVE_BY_TAG.get(rec["tag"], 0) - rec["nbytes"]
     _COUNTERS["released"] += 1
 
 
@@ -240,6 +260,7 @@ def register_buffer(value, *, tag: str = "leaf", split=None) -> Optional[int]:
     _LIVE_BYTES[0] += nbytes
     if _LIVE_BYTES[0] > _PEAK_LIVE[0]:
         _PEAK_LIVE[0] = _LIVE_BYTES[0]
+    _tag_add(_LEDGER[buf_id]["tag"], nbytes)
     return buf_id
 
 
@@ -250,8 +271,12 @@ def tag_buffer(value, tag: str) -> None:
     if telemetry._LEVEL < telemetry._EVENTS or not _ENABLED[0]:
         return
     rec = _LEDGER.get(id(value))
-    if rec is not None and tag in TAGS:
+    if rec is not None and tag in TAGS and tag != rec["tag"]:
+        _LIVE_BY_TAG[rec["tag"]] = (
+            _LIVE_BY_TAG.get(rec["tag"], 0) - rec["nbytes"]
+        )
         rec["tag"] = tag
+        _tag_add(tag, rec["nbytes"])
 
 
 def _pinned_ids() -> set:

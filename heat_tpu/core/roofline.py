@@ -161,6 +161,16 @@ def attribute(entry: dict, peaks: Optional[dict] = None) -> Optional[dict]:
             verdict = "unknown-peak"  # no predicted work on either axis
         else:
             verdict = "memory-bound" if t_hbm >= t_compute else "compute-bound"
+    # host-I/O axis (round 22, core/stream.py): streaming programs carry
+    # the MEASURED fraction of pass wall spent blocked on host reads
+    # (queue stalls / total host-read seconds).  This overrides the
+    # structural verdict because it is an observation, not a model — a
+    # stream pass whose consumer waited for the disk most of the time is
+    # I/O-bound whatever the FLOP/byte ratio says, and the verdict stays
+    # honest even on unknown-peak CPU where the structural axes are mute.
+    io_stall = entry.get("io_stall_frac")
+    if io_stall is not None and io_stall >= 0.5:
+        verdict = "io-bound"
     # the memory axis (memtrack watermarks folded in by timed_call):
     # measured peak residency vs the cost model's predicted mandatory
     # traffic — the honest sequel to predicted-vs-measured time.  An
@@ -202,6 +212,8 @@ def attribute(entry: dict, peaks: Optional[dict] = None) -> Optional[dict]:
         "mem_amplification": amp,
         "mem_source": entry.get("mem_source"),
         "verdict": verdict,
+        "io_stall_frac": io_stall,
+        "io_bytes": entry.get("io_bytes"),
         "mesh": entry.get("mesh"),
         "wire": wire,
         "wire_logical_bytes": w_logical if wire else None,

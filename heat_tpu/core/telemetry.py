@@ -83,6 +83,7 @@ from typing import Any, Callable, Dict, List, Optional
 from . import envparse
 
 __all__ = [
+    "annotate_program",
     "autotune_report",
     "current_span",
     "dump",
@@ -788,6 +789,20 @@ def program_hit(fp: Optional[str]) -> None:
     got = _PROGRAMS.get(fp)
     if got is not None:
         got["hits"] += 1
+
+
+def annotate_program(fp: Optional[str], **fields) -> None:
+    """Merge extra fields into an existing ledger entry WITHOUT touching
+    its compile/hit counts — the streaming engine's measured I/O axis
+    (``io_stall_frac``, ``io_bytes``) lands here after each pass, where a
+    ``record_program`` re-record would fake a compile.  No-op for unseen
+    fingerprints (annotation never creates an entry: a program with no
+    recorded cost model has nothing for roofline rows to attribute)."""
+    if fp is None or _LEVEL < _COUNTERS:
+        return
+    got = _PROGRAMS.get(fp)
+    if got is not None:
+        got.update(fields)
 
 
 def programs() -> List[dict]:

@@ -592,6 +592,15 @@ class ServingEngine:
                 duration = time.perf_counter() - t0
             telemetry.record_timing(step.fingerprint, duration)
             telemetry.program_hit(step.fingerprint)
+            # streamed-corpus models (KNeighborsClassifier.fit_stream)
+            # measure per-pass I/O overlap; surface it on the serving
+            # flight recorder next to the batch that paid for it
+            stream_rep = getattr(endpoint.model, "last_stream_report", None)
+            if stream_rep:
+                telemetry.record_event(
+                    "serving_stream", endpoint=name, bucket=bucket,
+                    **stream_rep,
+                )
         except BaseException as exc:  # noqa: BLE001 — every future must resolve
             for request in requests:
                 try:

@@ -12,6 +12,7 @@ routes the O(n·m·f) work through the MXU as a matmul instead of the VPU.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -85,6 +86,36 @@ def _sq_euclidean(xa, ya):
     eps = jnp.finfo(d2.dtype).eps
     d2 = jnp.where(d2 <= 4.0 * eps * (x2 + y2), 0.0, d2)
     return jnp.maximum(d2, 0.0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _stream_topk_merge(q, slab, valid, base, best_d, best_i, k: int):
+    """Running k-nearest merge against one streamed corpus slab.
+
+    Squared distances from the queries to the slab (pad rows ``>= valid``
+    masked to +inf), global corpus ids from the traced ``base`` offset,
+    merged with the carried best-k via one ``lax.top_k`` over the
+    concatenation.  Distances stay SQUARED — monotone in the sqrt'd
+    metric, so the merged neighbor set (and any vote on it) matches the
+    in-memory ``cdist`` + ``top_k`` predict.  Tie behavior matches too:
+    ``top_k`` is stable, the carry (earlier global ids, themselves
+    ascending) precedes the slab's ascending ids in the concatenation, so
+    equal distances resolve to the smaller corpus index either way.
+    ``valid``/``base`` arrive as Python ints and trace as weak scalars —
+    every slab of a pass hits the same executable (no-retrace law)."""
+    rows = slab.shape[0]
+    d2 = _sq_euclidean(q, slab.astype(q.dtype))
+    d2 = jnp.where(
+        (jnp.arange(rows) < valid)[None, :], d2.astype(jnp.float32), jnp.inf
+    )
+    ids = jnp.broadcast_to(
+        (base + jnp.arange(rows, dtype=jnp.int32))[None, :],
+        (q.shape[0], rows),
+    )
+    cat_d = jnp.concatenate([best_d, d2], axis=1)
+    cat_i = jnp.concatenate([best_i, ids], axis=1)
+    neg, pos = jax.lax.top_k(-cat_d, k)
+    return -neg, jnp.take_along_axis(cat_i, pos, axis=1)
 
 
 def _euclid_kernel(xv, yv, dtype=None, sqrt=True):

@@ -128,8 +128,14 @@ class DataLoader:
         timeout: float = 0,
         worker_init_fn=None,
     ):
+        from .partial_dataset import PartialH5Dataset
+
         if isinstance(dataset, DNDarray):
             dataset = Dataset(dataset)
+        # out-of-core path (reference: the loader drives PartialH5Dataset's
+        # prefetch threads, partial_dataset.py:224): batches are streamed
+        # slabs off the core engine, one per reader round-trip
+        self._streaming = isinstance(dataset, PartialH5Dataset)
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
@@ -145,11 +151,18 @@ class DataLoader:
 
     def __len__(self) -> int:
         n = len(self.dataset)
+        if self._streaming:
+            return -(-n // self.dataset.slab_rows)
         if self.drop_last:
             return n // self.batch_size
         return -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator:
+        if self._streaming:
+            # slab-sized streamed batches; collate_fn still honored
+            for batch in iter(self.dataset):
+                yield self.collate_fn(batch) if self.collate_fn is not None else batch
+            return
         if self.shuffle:
             self.dataset.shuffle()  # no-op for test_set datasets
         n = len(self.dataset)

@@ -2,23 +2,24 @@
 heat/utils/data/partial_dataset.py, 359 LoC).
 
 ``PartialH5Dataset`` (:32) streams a too-big-for-memory HDF5 file: background
-threads read slabs and a conversion queue feeds training.  The TPU analog
-keeps the same shape: a host-side prefetch thread reads HDF5 slabs into a
-bounded queue while the device consumes sharded batches — host I/O overlaps
-device compute, which is the entire point of the reference design."""
+threads read slabs and a conversion queue feeds training.  Rebuilt (round 22)
+on the core streaming engine: sources open through
+:func:`heat_tpu.core.stream.open_source`, slabs read through the shared
+chunk reader, and the prefetch thread is the engine's reader — bounded
+queue, poison-pill shutdown, and reader exceptions propagated to the
+consumer.  The old hand-rolled reader had none of those: abandoning
+iteration mid-epoch leaked a daemon thread holding an open h5py handle.
+Iterators are context managers; ``close()`` (also run by ``__del__``)
+stops and joins every reader and closes every source.
+"""
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterator, List, Optional
+from typing import List, Optional
 
-import numpy as np
-
-import jax
-
-from ...core.dndarray import DNDarray
-from ...core import factories
+from ...core import factories, memtrack, stream
 
 __all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
 
@@ -26,9 +27,14 @@ __all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
 def queue_thread(q: "queue.Queue") -> None:
     """Worker loop that drains a queue of ``callable`` or ``(callable,
     *args)`` work items (reference: partial_dataset.py:20, the loader/convert
-    thread body).  Run as a daemon thread target."""
+    thread body).  Run as a daemon thread target.  A ``None`` item is the
+    poison pill: the loop marks it done and exits, so owners can shut the
+    worker down instead of abandoning it."""
     while True:
         items = q.get()
+        if items is None:
+            q.task_done()
+            return
         if isinstance(items, tuple):
             items[0](*items[1:])
         else:
@@ -65,18 +71,17 @@ class PartialH5Dataset:
         initial_load: int = 7000,
         load_length: int = 2,
     ):
-        try:
-            import h5py
-        except ImportError as e:
-            raise RuntimeError("h5py is required for PartialH5Dataset") from e
         self.file = file
         self.comm = comm
         self.dataset_names = dataset_names or ["data"]
         self.transforms = transforms
         self.slab_rows = int(initial_load)
         self.prefetch_depth = int(load_length)
-        with h5py.File(file, "r") as handle:
-            self.total_size = handle[self.dataset_names[0]].shape[0]
+        try:
+            with stream.open_source(file, dataset=self.dataset_names[0]) as src:
+                self.total_size = int(src.shape[0])
+        except ImportError as e:
+            raise RuntimeError("h5py is required for PartialH5Dataset") from e
 
     def __len__(self) -> int:
         return self.total_size
@@ -100,49 +105,102 @@ class PartialH5Dataset:
 
 
 class PartialH5DataLoaderIter:
-    """Background-threaded slab iterator (reference: partial_dataset.py:224).
+    """Background-threaded slab iterator on the core streaming engine
+    (reference: partial_dataset.py:224).
 
     ``loader`` is the reference's parameter name — it passes its DataLoader
     whose ``.dataset`` is the :class:`PartialH5Dataset`; a bare dataset is
-    accepted too."""
+    accepted too.  One engine reader per streamed dataset feeds a bounded
+    queue; slabs arrive in lockstep tuples.  Reader failures surface as
+    ``RuntimeError`` at the consumer; ``close()`` (context-manager exit,
+    ``__del__``, or end of iteration) poison-pills and joins every reader
+    and closes every source — no leaked threads or handles."""
 
     def __init__(self, loader):
         dataset = getattr(loader, "dataset", loader)
         self.dataset = dataset
-        self._queue: "queue.Queue" = queue.Queue(maxsize=dataset.prefetch_depth)
-        self._error: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._reader, daemon=True)
-        self._thread.start()
-
-    def _reader(self) -> None:
-        import h5py
-
-        ds = self.dataset
+        self._closed = False
+        self._halt = threading.Event()
+        self._sources: List[stream.ChunkSource] = []
+        self._queues: List["queue.Queue"] = []
+        self._readers: List[stream._Reader] = []
         try:
-            with h5py.File(ds.file, "r") as handle:
-                handles = [handle[name] for name in ds.dataset_names]
-                for lo in range(0, ds.total_size, ds.slab_rows):
-                    hi = min(lo + ds.slab_rows, ds.total_size)
-                    slab = tuple(np.asarray(h[lo:hi]) for h in handles)
-                    self._queue.put(slab)
-        except BaseException as e:  # surface I/O errors to the consumer
-            self._error = e
-        finally:
-            self._queue.put(None)
+            for name in dataset.dataset_names:
+                src = stream.open_source(dataset.file, dataset=name)
+                self._sources.append(src)
+                q: "queue.Queue" = queue.Queue(maxsize=dataset.prefetch_depth)
+                self._queues.append(q)
+                self._readers.append(
+                    stream._Reader(
+                        src, q, dataset.slab_rows, dataset.total_size,
+                        self._halt,
+                    )
+                )
+        except Exception as e:
+            self.close()
+            raise RuntimeError(
+                f"cannot open streamed datasets in {dataset.file!r}"
+            ) from e
+        for r in self._readers:
+            r.start()
+
+    def close(self) -> None:
+        """Stop and join the readers, close the sources.  Idempotent;
+        safe mid-epoch — this is the shutdown path the old implementation
+        lacked."""
+        if self._closed:
+            return
+        self._closed = True
+        self._halt.set()
+        for q in self._queues:
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        for r in self._readers:
+            if r.is_alive():
+                r.join(timeout=5.0)
+        for src in self._sources:
+            src.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "PartialH5DataLoaderIter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        slab = self._queue.get()
-        if slab is None:
-            if self._error is not None:
+        if self._closed:
+            raise StopIteration
+        items = [q.get() for q in self._queues]
+        if any(item is None for item in items):
+            errors = [r.error for r in self._readers if r.error is not None]
+            self.close()
+            if errors:
                 raise RuntimeError(
                     f"background reader failed for {self.dataset.file!r}"
-                ) from self._error
+                ) from errors[0]
             raise StopIteration
         # one host→device transfer per slab, sharded over the sample axis
-        out = tuple(factories.array(part, split=0, comm=self.dataset.comm) for part in slab)
+        # (async device_put inside factories.array; the readers are already
+        # pulling the NEXT slabs off disk while the device works on these)
+        out = []
+        for _lo, host in items:
+            x = factories.array(host, split=0, comm=self.dataset.comm)
+            memtrack.tag_buffer(x.larray, "staging")
+            out.append(x)
+        out = tuple(out)
         if self.dataset.transforms is not None:
             out = self.dataset.transforms(*out)
         return out[0] if len(out) == 1 else out

@@ -121,6 +121,17 @@
 #                 >=3x exact-ledger HBM residency vs the dense affinity
 #                 at <=5% density, with zero steady-state
 #                 densifications — under the regression gate
+#  23. stream    — out-of-core streaming engine (ISSUE 20): the stream
+#                 test file at meshes 8/4/1 (chunk-source/plan laws,
+#                 kmeans/GNB parity + bitwise k-NN labels across slab
+#                 boundaries, measured-budget seeding with the ledgered
+#                 staging peak under budget, injected-OOM slab shrink,
+#                 slab-arm rotation/persistence, serving no-retrace,
+#                 reader-thread hygiene), then a live fit — KMeans on a
+#                 file-backed corpus 4x the residency budget must match
+#                 the in-memory centroids with the memtrack staging
+#                 peak <= budget and a well-formed overlap fraction —
+#                 and the cb stream suite under the regression gate
 #
 # Usage: scripts/ci.sh [--quick]   (--quick: subset suite for fast local runs)
 set -euo pipefail
@@ -133,7 +144,7 @@ QUICK="${1:-}"
 
 say() { printf '\n=== %s ===\n' "$*"; }
 
-say "1/22 suite (8-device mesh)"
+say "1/23 suite (8-device mesh)"
 SUITE_ARGS=(-q -p no:cacheprovider)
 if [ "$QUICK" = "--quick" ]; then
   SUITE_ARGS+=(tests/test_core.py tests/test_operations.py tests/test_collectives.py)
@@ -142,21 +153,21 @@ else
 fi
 python -m pytest "${SUITE_ARGS[@]}" 2>&1 | tee /tmp/ci_suite.log
 
-say "2/22 core subset (4-device mesh)"
+say "2/23 core subset (4-device mesh)"
 HEAT_TEST_DEVICES=4 \
   python -m pytest -q -p no:cacheprovider \
   tests/test_core.py tests/test_operations.py tests/test_collectives.py \
   tests/test_dist_sort.py 2>&1 | tee /tmp/ci_mesh4.log
 
-say "3/22 parity audit (exits nonzero on any gap)"
+say "3/23 parity audit (exits nonzero on any gap)"
 python scripts/parity_audit.py > /tmp/ci_parity.log
 tail -n 12 /tmp/ci_parity.log
 
-say "4/22 multi-chip dry-run"
+say "4/23 multi-chip dry-run"
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python __graft_entry__.py
 
-say "5/22 cb smoke"
+say "5/23 cb smoke"
 ( cd benchmarks/cb && python main.py --only manipulations --out /tmp/ci_cb_smoke.json )
 python - <<'EOF'
 import json
@@ -165,10 +176,10 @@ assert doc["measurements"], "cb smoke produced no measurements"
 print("cb smoke rows:", [m["name"] for m in doc["measurements"]])
 EOF
 
-say "6/22 copycheck"
+say "6/23 copycheck"
 python scripts/copycheck.py
 
-say "7/22 roofline notes (every low-roofline cb row carries its bound story)"
+say "7/23 roofline notes (every low-roofline cb row carries its bound story)"
 python - <<'EOF'
 import glob, json, sys
 bad = []
@@ -184,10 +195,10 @@ if bad:
 print("all low-roofline rows annotated")
 EOF
 
-say "8/22 fusion retrace guard (second call must hit the compile cache)"
+say "8/23 fusion retrace guard (second call must hit the compile cache)"
 ( cd benchmarks/cb && python fusion.py --verify-cache )
 
-say "9/22 guardrails (fault injection + strict-guard retrace check)"
+say "9/23 guardrails (fault injection + strict-guard retrace check)"
 # Injection is count-deterministic; the pinned seed documents the schedule
 # (equal seed + equal arming = identical fault sequence by construction).
 HEAT_TPU_INJECT_SEED=0 \
@@ -198,7 +209,7 @@ HEAT_TPU_INJECT_SEED=0 \
 # cost a recompile on the second invocation.
 ( cd benchmarks/cb && HEAT_TPU_GUARD=1 python fusion.py --verify-cache )
 
-say "10/22 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
+say "10/23 overlap engine (ring==gspmd laws + no-retrace, forced ring mode)"
 # once under auto dispatch (the suite already ran them; this leg pins the
 # forced-ring mode: every eligible matmul and ring cdist must stay law-equal
 # and the engine's build/hit counters must show zero retraces)
@@ -206,13 +217,13 @@ HEAT_TPU_MATMUL=ring \
   python -m pytest -q -p no:cacheprovider \
   tests/test_overlap.py tests/test_ring_cdist.py 2>&1 | tee /tmp/ci_overlap.log
 
-say "11/22 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
+say "11/23 DAG scheduler (multi-output retrace + CSE + fused-tail guards)"
 # the 2-output program must be ONE cached executable (1 miss, >=1 cse_hit,
 # second call a pure hit) and a resplit-terminated chain must reach the
 # transport tile loop with no pre-pass materialization
 ( cd benchmarks/cb && python fusion.py --verify-multi )
 
-say "12/22 telemetry (flight recorder + registry laws + Prometheus export)"
+say "12/23 telemetry (flight recorder + registry laws + Prometheus export)"
 # the unified-telemetry contracts (ISSUE 8): span/event/ledger laws on the
 # 8-device mesh, the cb gate (off silent, snapshot==shims, injected OOM
 # trail, well-formed export), and a real cb run exporting a snapshot
@@ -243,7 +254,7 @@ for want in ("heat_tpu_fusion_misses", "heat_tpu_transport_oom_retries",
 print(f"cb --prom export OK: {len(samples)} gauges")
 EOF
 
-say "13/22 roofline attribution + perf-regression gate"
+say "13/23 roofline attribution + perf-regression gate"
 # measured per-program accounting, device peaks, trace export, and the
 # history gate: the test files first, then the live artifacts — a
 # Chrome-trace export from a real run must be Perfetto-shaped, the
@@ -292,7 +303,7 @@ print(f"check-regression OK: {len(reg['rows'])} rows judged "
       f"(backend={reg['backend']}, baseline rounds={reg['baseline_rounds']})")
 EOF
 
-say "14/22 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
+say "14/23 memtrack (HBM residency ledger + OOM forensics, meshes 8/4/1)"
 # the residency-ledger contracts (ISSUE 10) at three mesh sizes, then a
 # live end-to-end forensics check: census-bearing postmortem, informed
 # first retry from measured free HBM, and the memory counter track
@@ -357,7 +368,7 @@ print(f"memtrack forensics OK: census of {census['live_buffers']} buffers "
       f"bytes, {len(counters)} counter samples")
 EOF
 
-say "15/22 autotune (explore/exploit laws + live two-process warm start)"
+say "15/23 autotune (explore/exploit laws + live two-process warm start)"
 # the self-tuning-runtime contracts (ISSUE 11) at three mesh sizes, then a
 # live warm-start check: process 1 explores, resolves winners and saves its
 # table; process 2 loads the cache at import and must do ZERO explores —
@@ -445,7 +456,7 @@ assert not reg["regressions"], \
 print(f"autotuned check-regression OK: {len(reg['rows'])} rows judged")
 EOF
 
-say "16/22 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
+say "16/23 Pallas kernel tier (interpret-mode laws + cb rows, meshes 8/4/1)"
 # the kernel-tier contracts (ISSUE 12) at three mesh sizes: each test
 # scopes HEAT_TPU_PALLAS=interpret itself, so plain pytest runs suffice —
 # repack bit-exactness (incl. the pad-lane regression), fused QR panel vs
@@ -495,7 +506,7 @@ print(f"cb kernels OK: {len(rows)} rows (arms={sorted(arms)}), "
       f"{len(reg['rows'])} judged, {len(samples)} gauges")
 EOF
 
-say "17/22 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
+say "17/23 SPMD hazard analyzer (lint gate + auditor/sanitizer laws, meshes 8/4/1)"
 # the static gate: the shipped tree must self-check clean — every
 # residual finding either fixed, inline-justified (# ht: HTxxx ok), or
 # carried in analysis/baseline.json with a human reason
@@ -533,7 +544,7 @@ else:
     raise SystemExit("planted use-after-donate was NOT caught")
 EOF_SAN
 
-say "18/22 serving front door (bucketed batching laws + live warm-started serve, meshes 8/4/1)"
+say "18/23 serving front door (bucketed batching laws + live warm-started serve, meshes 8/4/1)"
 # the serving contracts (ISSUE 14) at three mesh sizes: bucket ladder,
 # the no-retrace law under mixed concurrent traffic, every admission
 # shed reason including the injected-stall fast-fail, drain semantics,
@@ -649,7 +660,7 @@ print(f"cb serving_batch OK: {row['speedup']}x batched vs sequential, "
       f"{row['drain_flushes']} drain flushes")
 EOF
 
-say "19/22 quantized inference epilogues (int8 laws + cb rows, meshes 8/4/1)"
+say "19/23 quantized inference epilogues (int8 laws + cb rows, meshes 8/4/1)"
 # the quantize contracts (ISSUE 15) at three mesh sizes: per-channel
 # round-trip bound, shard-boundary exactness through the k-pad mask,
 # explore-returns-bf16 bitwise, HEAT_TPU_AUTOTUNE=off bit-for-bit with
@@ -695,7 +706,7 @@ print(f"cb quantize OK: arms={arms}, residency={ratios}, "
       f"{len(reg['rows'])} rows judged")
 EOF
 
-say "20/22 quantized collectives (wire laws + cb rows, meshes 8/4/1)"
+say "20/23 quantized collectives (wire laws + cb rows, meshes 8/4/1)"
 # the wire contracts (ISSUE 16) at three mesh sizes: the absmax/254
 # round-trip bound, off-mode bit-for-bit with zero wire-arm table
 # decisions, forced int8/fp8 through resplit / fused tail / ring matmul
@@ -754,7 +765,7 @@ print(f"cb wire OK: ratios={ratios}, max_errors={errs}, "
       f"{len(reg['rows'])} rows judged")
 EOF
 
-say "21/22 fleet router (failure matrix meshes 8/4/1 + live fault drill)"
+say "21/23 fleet router (failure matrix meshes 8/4/1 + live fault drill)"
 # the fleet contracts (ISSUE 18) at three mesh sizes: consistent-hash
 # affinity, the full failure matrix (mid-step stall -> eject + failover
 # with zero lost futures, error burst -> circuit -> half-open probe
@@ -877,7 +888,7 @@ print(f"fault drill OK: served={served} shed_low={shed_terminal} "
       f"probes={stats['probes']} shed_ledger={shed_ledger} lost=0")
 EOF
 
-say "22/22 sparse compute tier (SpMV laws meshes 8/4/1 + cb rows)"
+say "22/23 sparse compute tier (SpMV laws meshes 8/4/1 + cb rows)"
 # the sparse contracts (ISSUE 19) at three mesh sizes: ELL pack layout
 # laws, gather/kernel(interpret)-vs-dense BIT parity incl. the ragged
 # last shard and an all-zero-rows shard, explore-returns-dense bitwise,
@@ -934,6 +945,101 @@ ratios = {n: rows[n]["residency_ratio"]
           for n in ("spmv_csr", "spectral_sparse")}
 print(f"cb sparse OK: arms={arms}, residency={ratios}, "
       f"{len(reg['rows'])} rows judged")
+EOF
+
+say "23/23 out-of-core streaming engine (stream laws meshes 8/4/1 + live budgeted fit + cb rows)"
+# the streaming contracts (ISSUE 20) at three mesh sizes: chunk-source
+# and 3-slab plan laws, kmeans/GNB parity + BITWISE k-NN labels across
+# every slab boundary, measured-budget seeding (the ledgered staging
+# peak stays under the injected free//2 budget), env/explicit budget
+# overrides, injected-OOM slab shrink with labels still bitwise, the
+# floor re-raise, slab-arm rotation + persistence, the serving
+# no-retrace law under mixed concurrent traffic, and reader-thread +
+# source-handle hygiene
+python -m pytest -q -p no:cacheprovider \
+  tests/test_stream.py 2>&1 | tee /tmp/ci_stream.log
+HEAT_TEST_DEVICES=4 \
+  python -m pytest -q -p no:cacheprovider tests/test_stream.py
+HEAT_TEST_DEVICES=1 \
+  python -m pytest -q -p no:cacheprovider tests/test_stream.py
+# live acceptance drill: KMeans.fit on a FILE-BACKED corpus 4x the
+# residency budget must match the in-memory centroids at the documented
+# tolerance, with the memtrack staging peak under the budget and a
+# well-formed measured prefetch-overlap fraction
+XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'EOF'
+import os, tempfile
+import numpy as np
+import heat_tpu as ht
+from heat_tpu.core import memtrack, telemetry
+
+prev = telemetry.set_level("events")
+memtrack.reset()
+rng = np.random.default_rng(22)
+n, f, k = 16_384, 32, 4
+centers = rng.normal(0.0, 5.0, size=(k, f))
+x_np = (centers[rng.integers(0, k, size=n)]
+        + rng.normal(0.0, 0.3, size=(n, f))).astype(np.float32)
+with tempfile.TemporaryDirectory() as tmp:
+    path = os.path.join(tmp, "corpus.npy")
+    np.save(path, x_np)
+    budget = x_np.nbytes // 4  # the corpus is exactly 4x the budget
+    init = ht.array(x_np[:k].copy(), split=None)
+    km_mem = ht.cluster.KMeans(n_clusters=k, init=init, max_iter=5, tol=1e-6)
+    km_mem.fit(ht.array(x_np, split=0))
+    km = ht.cluster.KMeans(n_clusters=k, init=init, max_iter=5, tol=1e-6)
+    km.fit_stream(path, budget=budget)
+rep = km.last_stream_report
+peak = memtrack.summary()["peak_bytes_by_tag"].get("staging", 0)
+assert 0 < peak <= budget, (peak, budget)
+assert rep["slabs"] >= 4, rep
+assert 0.0 <= rep["overlap_frac"] <= 1.0, rep
+np.testing.assert_allclose(
+    np.asarray(km.cluster_centers_.larray),
+    np.asarray(km_mem.cluster_centers_.larray),
+    rtol=1e-4, atol=1e-5,
+)
+assert telemetry.events(kind="stream_pass"), "stream_pass events missing"
+telemetry.set_level(prev)
+print(f"stream fit OK: slabs={rep['slabs']} peak={peak} budget={budget} "
+      f"overlap={rep['overlap_frac']:.3f} passes={km._n_iter}")
+EOF
+# the cb stream suite end-to-end on the 8-way mesh: both rows through
+# the real consumers with the slab arm recorded, the ledgered
+# peak-vs-budget and centroid-parity bars re-checked from the emitted
+# document, and the regression gate green
+( cd benchmarks/cb && \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  HEAT_TPU_AUTOTUNE=on HEAT_TPU_TELEMETRY=events \
+  python main.py --only stream --check-regression \
+  --out /tmp/ci_cb_stream.json )
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/ci_cb_stream.json"))
+rows = {m["name"]: m for m in doc["measurements"]}
+for want in ("stream_kmeans", "stream_knn_serving"):
+    assert want in rows, f"cb stream suite missing row {want}"
+    assert rows[want].get("note"), f"{want} lacks its honesty note"
+    assert rows[want].get("arm"), f"{want} lacks a slab arm"
+    assert 0.0 <= rows[want]["overlap_frac"] <= 1.0, rows[want]
+km = rows["stream_kmeans"]
+# THE acceptance bars (also asserted inside the workload itself): the
+# corpus is >=4x the budget, the ledgered staging peak respects the
+# budget, and the streamed centroids match the in-memory fit
+assert km["corpus_mb"] >= 4 * km["budget_mb"], km
+assert 0 < km["peak_staging_mb"] <= km["budget_mb"], km
+assert km["centroid_max_delta"] <= 1e-4, km
+assert km["slabs"] >= 4, km
+knn = rows["stream_knn_serving"]
+assert knn["step_compiles_delta"] == 0, knn
+assert knn["fusion_misses_delta"] == 0, knn
+assert knn["stream_passes"] > 0, knn
+reg = doc["regression"]
+assert reg["rows"], "check-regression attached an empty delta table"
+assert not reg["regressions"], f"stream regressions: {reg['regressions']}"
+arms = {n: rows[n]["arm"] for n in rows}
+print(f"cb stream OK: arms={arms}, "
+      f"peak/budget={km['peak_vs_budget']}, "
+      f"overlap={km['overlap_frac']}, {len(reg['rows'])} rows judged")
 EOF
 
 say "CI GREEN"
